@@ -1,0 +1,23 @@
+"""harmony-tpu: a TPU-native (JAX/XLA/Pallas) execution framework giving
+Harmony's FBFT consensus a TPU backend for its BLS12-381 signature pipeline.
+
+The reference implementation (harmony-one/harmony) routes every
+sign/verify/aggregate through a cgo boundary into the herumi bls/mcl C++
+libraries (reference: go.mod:27, crypto/bls/bls.go:17-20).  This package
+replaces that boundary with:
+
+- ``harmony_tpu.ref``     — a pure-Python bigint ground-truth implementation
+  (the stand-in for the mcl/herumi CPU path; every TPU kernel is tested
+  bitwise against it).
+- ``harmony_tpu.ops``     — the batched JAX/Pallas compute path: 381-bit
+  field arithmetic as fixed-limb int32 vectors, tower fields, G1/G2 group
+  ops, the optimal-ate pairing, and the BLS op surface that mirrors the
+  reference's cgo call sites (SURVEY.md §2.1).
+- ``harmony_tpu.parallel``— device-mesh sharding (pjit/shard_map) for batch
+  pairing and masked key aggregation across chips.
+- ``harmony_tpu.consensus``— host-side FBFT-adjacent logic: bitmap mask
+  semantics (reference: crypto/bls/mask.go), commit payload construction
+  (reference: consensus/signature/signature.go:12-24), quorum policies.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Pure-Python bigint reference implementation of BLS12-381.
+
+This subpackage is the ground truth for every TPU kernel in
+``harmony_tpu.ops`` and doubles as the host-side CPU fallback — the analog
+of the reference chain's herumi/mcl cgo path (reference: crypto/bls/bls.go,
+Makefile:68-70).  It is deliberately written for clarity and auditability:
+plain Python integers, affine formulas, no Montgomery domain.
+
+Nothing here imports JAX.
+"""
+
+from . import params  # noqa: F401

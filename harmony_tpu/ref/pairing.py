@@ -1,0 +1,91 @@
+"""Optimal ate pairing on BLS12-381 over bigints (ground truth).
+
+e(P, Q) for P in G1, Q in G2 is computed as f_{|x|, psi(Q)}(P) raised to
+(p^12 - 1)/r, conjugated once because the BLS parameter x is negative.
+
+This implementation optimises for auditability, not speed: the Miller loop
+uses affine line functions on the untwisted curve E(Fp12), and the final
+exponentiation's hard part is a generic square-and-multiply by the integer
+(p^4 - p^2 + 1)/r.  The TPU path (ops/pairing.py) uses projective twist
+coordinates, sparse line multiplication and the x-addition-chain hard part,
+and is tested to produce identical GT elements to this function.
+
+Replaces the reference's pairing entry points Sign.VerifyHash /
+aggregate-verify (reference: consensus/leader.go:173, consensus/
+validator.go:228, internal/chain/engine.go:640), which live inside herumi's
+C++ mcl library.
+"""
+
+from . import fields as F
+from .curve import e12, g1_embed, untwist
+from .params import P, R_ORDER, X
+
+_ABS_X_BITS = bin(-X)[2:]  # x < 0 for BLS12-381
+
+
+def _line(t, r_pt, p_pt):
+    """Evaluate at p_pt the line through t and r_pt (tangent if t == r_pt).
+
+    All points are affine on E(Fp12).  Vertical lines (r == -t) evaluate as
+    x_P - x_T; they appear only at the very last add step when the scalar is
+    the group order, which |x| is not, but the case is handled for safety.
+    """
+    xt, yt = t
+    xp, yp = p_pt
+    if t == r_pt:
+        # tangent: lambda = 3 x^2 / 2 y
+        num = e12.fmul(F.fp_to_fp12(3), e12.fmul(xt, xt))
+        den = e12.fmul(F.fp_to_fp12(2), yt)
+    else:
+        xr, yr = r_pt
+        if xt == xr:
+            return e12.fsub(xp, xt)  # vertical
+        num = e12.fsub(yr, yt)
+        den = e12.fsub(xr, xt)
+    lam = e12.fmul(num, e12.finv(den))
+    # l(P) = lambda (x_P - x_T) - (y_P - y_T)
+    return e12.fsub(e12.fmul(lam, e12.fsub(xp, xt)), e12.fsub(yp, yt))
+
+
+def miller_loop(p_pt, q_pt):
+    """f_{|x|, Q'}(P') on E(Fp12); returns an Fp12 element (pre-final-exp)."""
+    if p_pt is None or q_pt is None:
+        return F.FP12_ONE
+    pp = g1_embed(p_pt)
+    qq = untwist(q_pt)
+    f = F.FP12_ONE
+    t = qq
+    for bit in _ABS_X_BITS[1:]:
+        f = F.fp12_mul(F.fp12_sqr(f), _line(t, t, pp))
+        t = e12.dbl(t)
+        if bit == "1":
+            f = F.fp12_mul(f, _line(t, qq, pp))
+            t = e12.add(t, qq)
+    # x < 0: f_{-|x|} ~ conj(f_{|x|}) up to factors killed by the final exp.
+    return F.fp12_conj(f)
+
+
+def final_exponentiation(f):
+    """f^((p^12 - 1) / r).
+
+    Easy part: f^(p^6 - 1) = conj(f)/f, then ^(p^2 + 1) by generic pow.
+    Hard part: generic pow by (p^4 - p^2 + 1)/r.
+    """
+    f1 = F.fp12_mul(F.fp12_conj(f), F.fp12_inv(f))  # ^(p^6 - 1)
+    f2 = F.fp12_mul(F.fp12_pow(f1, P * P), f1)  # ^(p^2 + 1)
+    hard = (P**4 - P**2 + 1) // R_ORDER
+    return F.fp12_pow(f2, hard)
+
+
+def pairing(p_pt, q_pt):
+    """Full optimal ate pairing e(P, Q) in GT."""
+    return final_exponentiation(miller_loop(p_pt, q_pt))
+
+
+def multi_pairing(pairs):
+    """prod_i e(P_i, Q_i): shared final exponentiation over the products of
+    Miller loops — the structure the TPU batch-verify kernel exploits."""
+    f = F.FP12_ONE
+    for p_pt, q_pt in pairs:
+        f = F.fp12_mul(f, miller_loop(p_pt, q_pt))
+    return final_exponentiation(f)

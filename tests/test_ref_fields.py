@@ -1,0 +1,102 @@
+"""Algebraic-identity tests for the bigint tower fields."""
+
+import random
+
+from harmony_tpu.ref import fields as F
+from harmony_tpu.ref.params import P
+
+rng = random.Random(0xB15)
+
+
+def rand_fp():
+    return rng.randrange(P)
+
+
+def rand_fp2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_fp6():
+    return (rand_fp2(), rand_fp2(), rand_fp2())
+
+
+def rand_fp12():
+    return (rand_fp6(), rand_fp6())
+
+
+def test_fp2_ring_axioms():
+    for _ in range(20):
+        a, b, c = rand_fp2(), rand_fp2(), rand_fp2()
+        assert F.fp2_mul(a, b) == F.fp2_mul(b, a)
+        assert F.fp2_mul(a, F.fp2_add(b, c)) == F.fp2_add(
+            F.fp2_mul(a, b), F.fp2_mul(a, c)
+        )
+        assert F.fp2_mul(F.fp2_mul(a, b), c) == F.fp2_mul(a, F.fp2_mul(b, c))
+
+
+def test_fp2_inverse_and_conj():
+    for _ in range(20):
+        a = rand_fp2()
+        assert F.fp2_mul(a, F.fp2_inv(a)) == F.FP2_ONE
+        # conj is the p-power Frobenius
+        assert F.fp2_conj(a) == tuple_pow_p(a)
+
+
+def tuple_pow_p(a):
+    # a^p via binary pow in Fp2 (slow; only for this test)
+    result = F.FP2_ONE
+    base = a
+    e = P
+    while e:
+        if e & 1:
+            result = F.fp2_mul(result, base)
+        base = F.fp2_mul(base, base)
+        e >>= 1
+    return result
+
+
+def test_fp2_sqrt_roundtrip():
+    found = 0
+    for _ in range(20):
+        a = rand_fp2()
+        s = F.fp2_sqrt(a)
+        if s is not None:
+            assert F.fp2_sqr(s) == a
+            found += 1
+    assert found > 0  # ~half of elements are squares
+    # squares always have roots
+    for _ in range(10):
+        a = rand_fp2()
+        sq = F.fp2_sqr(a)
+        s = F.fp2_sqrt(sq)
+        assert s is not None and F.fp2_sqr(s) == sq
+
+
+def test_fp6_inverse_and_v_reduction():
+    for _ in range(10):
+        a = rand_fp6()
+        assert F.fp6_mul(a, F.fp6_inv(a)) == F.FP6_ONE
+        # v^3 = xi: multiplying three times by v == multiplying by xi
+        v3 = F.fp6_mul_v(F.fp6_mul_v(F.fp6_mul_v(a)))
+        xi_a = tuple(F.fp2_mul_xi(c) for c in a)
+        assert v3 == xi_a
+
+
+def test_fp12_inverse_mul_pow():
+    for _ in range(5):
+        a, b = rand_fp12(), rand_fp12()
+        assert F.fp12_mul(a, F.fp12_inv(a)) == F.FP12_ONE
+        assert F.fp12_mul(a, b) == F.fp12_mul(b, a)
+        assert F.fp12_pow(a, 5) == F.fp12_mul(
+            F.fp12_mul(F.fp12_sqr(F.fp12_sqr(a)), a), F.FP12_ONE
+        )
+
+
+def test_fp12_conj_is_p6_frobenius():
+    # w^2 = v, and conj negates w-coefficient: conj(a) == a^(p^6) — check via
+    # the multiplicative property conj(ab) = conj(a) conj(b) and conj(w) = -w
+    a, b = rand_fp12(), rand_fp12()
+    assert F.fp12_conj(F.fp12_mul(a, b)) == F.fp12_mul(
+        F.fp12_conj(a), F.fp12_conj(b)
+    )
+    assert F.fp12_conj(F.FP12_W) == F.fp12_sub(F.FP12_ZERO, F.FP12_W)

@@ -1,0 +1,114 @@
+"""Pairing bilinearity and BLS end-to-end tests for the reference layer.
+
+Marked-slow cases are the bigint pairing computations (~0.3 s each); the
+suite keeps the count small — the TPU tests get their ground truth from
+fixture values computed here once.
+"""
+
+import random
+
+import pytest
+
+from harmony_tpu.ref import bls
+from harmony_tpu.ref import fields as F
+from harmony_tpu.ref import pairing as PR
+from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
+from harmony_tpu.ref.hash_to_curve import hash_to_g2, map_to_twist
+from harmony_tpu.ref.params import R_ORDER
+
+rng = random.Random(0x9A1)
+
+
+@pytest.fixture(scope="module")
+def e_gen():
+    return PR.pairing(G1_GEN, G2_GEN)
+
+
+def test_pairing_nondegenerate_order_r(e_gen):
+    assert e_gen != F.FP12_ONE
+    assert F.fp12_pow(e_gen, R_ORDER) == F.FP12_ONE
+
+
+def test_bilinearity(e_gen):
+    a = rng.randrange(1, 1 << 64)
+    b = rng.randrange(1, 1 << 64)
+    eab = PR.pairing(g1.mul(G1_GEN, a), g2.mul(G2_GEN, b))
+    assert eab == F.fp12_pow(e_gen, a * b)
+
+
+def test_multi_pairing_matches_product(e_gen):
+    # e(-G1, 2 G2) * e(2 G1, G2) == 1
+    gt = PR.multi_pairing(
+        [(g1.neg(G1_GEN), g2.dbl(G2_GEN)), (g1.dbl(G1_GEN), G2_GEN)]
+    )
+    assert gt == F.FP12_ONE
+
+
+def test_hash_to_g2_deterministic_subgroup():
+    h1 = hash_to_g2(b"m" * 32)
+    h2 = hash_to_g2(b"m" * 32)
+    assert h1 == h2
+    assert g2.is_on_curve(h1)
+    assert g2.mul(h1, R_ORDER) is None
+    assert hash_to_g2(b"n" * 32) != h1
+
+
+def test_map_to_twist_off_subgroup_is_handled():
+    pt = map_to_twist(b"x" * 32)
+    assert g2.is_on_curve(pt)
+
+
+def test_bls_sign_verify():
+    sk = bls.keygen(b"\x01")
+    pk = bls.pubkey(sk)
+    msg = b"0123456789abcdef0123456789abcdef"
+    sig = bls.sign(sk, msg)
+    assert bls.verify(pk, msg, sig)
+    assert not bls.verify(pk, b"y" * 32, sig)
+    assert not bls.verify(bls.pubkey(sk + 1), msg, sig)
+
+
+def test_bls_aggregate_verify():
+    sks = [bls.keygen(bytes([i])) for i in range(3)]
+    pks = [bls.pubkey(sk) for sk in sks]
+    msg = b"0123456789abcdef0123456789abcdef"
+    sigs = [bls.sign(sk, msg) for sk in sks]
+    agg = bls.aggregate_sigs(sigs)
+    assert bls.verify_aggregate(pks, msg, agg)
+    assert not bls.verify_aggregate(pks[:2], msg, agg)
+
+
+def test_serialization_roundtrip_and_sizes():
+    sk = bls.keygen(b"\x07")
+    pk = bls.pubkey(sk)
+    msg = b"0123456789abcdef0123456789abcdef"
+    sig = bls.sign(sk, msg)
+    pkb, sigb = bls.pubkey_to_bytes(pk), bls.sig_to_bytes(sig)
+    assert len(pkb) == 48 and len(sigb) == 96
+    assert bls.pubkey_from_bytes(pkb) == pk
+    assert bls.sig_from_bytes(sigb) == sig
+    # infinity encodings
+    assert bls.pubkey_from_bytes(bytes([0xC0]) + bytes(47)) is None
+    assert bls.sig_from_bytes(bytes([0xC0]) + bytes(95)) is None
+    # negated point flips the sign bit
+    negb = bls.pubkey_to_bytes(g1.neg(pk))
+    assert negb[0] ^ pkb[0] == 0x20
+
+
+def test_keccak_vectors():
+    from harmony_tpu.ref.keccak import keccak256
+
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    assert len(keccak256(b"x" * 1000)) == 32
+    # rate-1 input length exercises the single-byte 0x81 padding branch
+    assert (
+        keccak256(b"z" * 135).hex()
+        == "796f5184228df590c13bfb8992d2c10b6562903362103899249736357eb573fd"
+    )
