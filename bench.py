@@ -1,0 +1,75 @@
+"""Benchmark: BLS12-381 pairing throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.md): >= 50_000 pairings/s sustained on 1x TPU v5e.
+
+Measures the batched full pairing (Miller loop + final exponentiation)
+at the largest batch that fits comfortably, steady-state (post-compile),
+wall-clock per device-complete iteration.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from harmony_tpu.ops import interop as I
+    from harmony_tpu.ops import pairing as OP
+    from harmony_tpu.ref import bls as RB
+    from harmony_tpu.ref.curve import g1, g2, G1_GEN, G2_GEN
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+
+    # distinct inputs (scalar multiples of the generators), tiled to batch
+    base_p = [G1_GEN, g1.dbl(G1_GEN), g1.mul(G1_GEN, 5), g1.mul(G1_GEN, 7)]
+    base_q = [G2_GEN, g2.dbl(G2_GEN), g2.mul(G2_GEN, 5), g2.mul(G2_GEN, 7)]
+    p_arr = I.g1_batch_affine(base_p)
+    q_arr = I.g2_batch_affine(base_q)
+    reps = (batch + 3) // 4
+    ps = jnp.asarray(np.tile(p_arr, (reps, 1, 1))[:batch])
+    qs = jnp.asarray(np.tile(q_arr, (reps, 1, 1, 1))[:batch])
+
+    fn = jax.jit(OP.pairing)
+    out = fn(ps, qs)
+    out.block_until_ready()  # compile + warm
+
+    # correctness guard: bench numbers only count if results are right
+    e1 = I.arr_to_fp12(np.array(out[0]))
+    from harmony_tpu.ref import pairing as RP
+
+    assert e1 == RP.pairing(G1_GEN, G2_GEN), "bench result wrong!"
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn(ps, qs).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    pairings_per_s = batch / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "bls12_381_pairings_per_sec_per_chip",
+                "value": round(pairings_per_s, 1),
+                "unit": "pairings/s",
+                "vs_baseline": round(pairings_per_s / 50_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

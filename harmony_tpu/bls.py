@@ -1,0 +1,138 @@
+"""User-facing BLS API: the framework's equivalent of the reference's
+crypto/bls wrapper types (reference: crypto/bls/bls.go:23-33 —
+PublicKeyWrapper / PrivateKeyWrapper pairing a deserialized object with
+its serialized bytes) and the herumi object surface the node code calls.
+
+Single-signature operations run on the host bigint path (they are
+latency-trivial); batch and aggregate operations route through the TPU
+ops (harmony_tpu.ops.bls) — the boundary the reference crosses via cgo.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .ref import bls as RB
+from .ref import curve as RC
+from .ref.params import PUBKEY_BYTES, SIG_BYTES
+
+
+class PublicKey:
+    """Wrapper pairing the affine point with its 48-byte serialization."""
+
+    __slots__ = ("point", "_bytes")
+
+    def __init__(self, point, serialized: bytes | None = None):
+        self.point = point
+        self._bytes = serialized
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        return cls(RB.pubkey_from_bytes(data), bytes(data))
+
+    @property
+    def bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = RB.pubkey_to_bytes(self.point)
+        return self._bytes
+
+    def add(self, other: "PublicKey") -> "PublicKey":
+        return PublicKey(RC.g1.add(self.point, other.point))
+
+    def sub(self, other: "PublicKey") -> "PublicKey":
+        return PublicKey(RC.g1.add(self.point, RC.g1.neg(other.point)))
+
+    def __eq__(self, o) -> bool:
+        return isinstance(o, PublicKey) and self.bytes == o.bytes
+
+    def __hash__(self):
+        return hash(self.bytes)
+
+    def __repr__(self):
+        return f"PublicKey({self.bytes[:4].hex()}..)"
+
+
+class Signature:
+    __slots__ = ("point", "_bytes")
+
+    def __init__(self, point, serialized: bytes | None = None):
+        self.point = point
+        self._bytes = serialized
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        return cls(RB.sig_from_bytes(data), bytes(data))
+
+    @property
+    def bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = RB.sig_to_bytes(self.point)
+        return self._bytes
+
+    def add(self, other: "Signature") -> "Signature":
+        """Aggregate (Sign.Add analog)."""
+        return Signature(RC.g2.add(self.point, other.point))
+
+    def verify(self, pub: PublicKey, msg_hash: bytes) -> bool:
+        """VerifyHash analog."""
+        return RB.verify(pub.point, msg_hash, self.point)
+
+    def __eq__(self, o) -> bool:
+        return isinstance(o, Signature) and self.bytes == o.bytes
+
+    def __repr__(self):
+        return f"Signature({self.bytes[:4].hex()}..)"
+
+
+class PrivateKey:
+    """Wrapper pairing the scalar with its derived public key (reference:
+    crypto/bls/bls.go PrivateKeyWrapper)."""
+
+    __slots__ = ("scalar", "pub")
+
+    def __init__(self, scalar: int):
+        self.scalar = scalar % RC.R_ORDER
+        self.pub = PublicKey(RB.pubkey(self.scalar))
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "PrivateKey":
+        return cls(RB.keygen(seed))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PrivateKey":
+        return cls(RB.sk_from_bytes(data))
+
+    @property
+    def bytes(self) -> bytes:
+        return RB.sk_to_bytes(self.scalar)
+
+    def sign_hash(self, msg_hash: bytes) -> Signature:
+        """SignHash analog: sign a (typically 32-byte) hash."""
+        return Signature(RB.sign(self.scalar, msg_hash))
+
+
+def aggregate_sigs(sigs) -> Signature:
+    """Sum signatures (AggregateSig — reference: crypto/bls/mask.go:57-64)."""
+    return Signature(RB.aggregate_sigs([s.point for s in sigs]))
+
+
+@functools.lru_cache(maxsize=1024)
+def _cached_pubkey_from_bytes(data: bytes):
+    return RB.pubkey_from_bytes(data)
+
+
+def pubkey_from_bytes_cached(data: bytes) -> PublicKey:
+    """Deserialization with the reference's 1024-entry LRU semantics
+    (reference: crypto/bls/mask.go:9-16)."""
+    return PublicKey(_cached_pubkey_from_bytes(bytes(data)), bytes(data))
+
+
+__all__ = [
+    "PublicKey",
+    "PrivateKey",
+    "Signature",
+    "aggregate_sigs",
+    "pubkey_from_bytes_cached",
+    "PUBKEY_BYTES",
+    "SIG_BYTES",
+]

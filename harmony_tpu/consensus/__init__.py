@@ -1,0 +1,4 @@
+"""Host-side FBFT consensus support: the framework pieces around the TPU
+crypto kernels that must stay deterministic and branchy on the host —
+bitmap masks, signable payload construction, vote-power rosters, quorum
+policies (reference: consensus/ + crypto/bls/mask.go; SURVEY.md §2.2)."""

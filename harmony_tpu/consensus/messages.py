@@ -1,0 +1,125 @@
+"""FBFT wire messages: types, signable payloads, and the aggregate
+sig-and-bitmap encoding.
+
+Behavioral parity with the reference's message construction (reference:
+consensus/construct.go:99-176 and api/proto/message/harmonymessage.pb.go
+MessageType values):
+
+- PREPARE / COMMIT carry [96-byte BLS signature over the phase payload],
+  locally aggregated across the node's multi-BLS keys;
+- PREPARED / COMMITTED carry [96-byte aggregate sig || bitmap], the O(1)
+  quorum proof (construct.go:157-176);
+- sender identification is the serialized pubkey list of the node's keys.
+
+Transport stays out of scope here (the reference uses libp2p gossip,
+which remains host-side Go in the deployment story — SURVEY.md §2.5);
+these are the payload semantics every transport must carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from ..ref.params import SIG_BYTES
+
+
+class MsgType(IntEnum):
+    """reference: api/proto/message/harmonymessage.pb.go:80-122."""
+
+    ANNOUNCE = 0
+    PREPARE = 1
+    PREPARED = 2
+    COMMIT = 3
+    COMMITTED = 4
+    VIEWCHANGE = 5
+    NEWVIEW = 6
+
+
+@dataclass
+class FBFTMessage:
+    msg_type: MsgType
+    view_id: int
+    block_num: int
+    block_hash: bytes
+    sender_pubkeys: list = field(default_factory=list)  # serialized 48B keys
+    payload: bytes = b""  # phase signature or [agg sig || bitmap]
+    block: bytes = b""  # RLP-ish block bytes (ANNOUNCE/PREPARED)
+
+    def key(self):
+        """Dedup/storage key (reference: consensus/fbft_log.go:128-143)."""
+        return (
+            self.msg_type,
+            self.view_id,
+            self.block_num,
+            self.block_hash,
+            tuple(self.sender_pubkeys),
+        )
+
+
+def encode_sig_and_bitmap(agg_sig_bytes: bytes, bitmap: bytes) -> bytes:
+    """[96B aggregate signature || participation bitmap]
+    (reference: consensus/construct.go:157-176)."""
+    if len(agg_sig_bytes) != SIG_BYTES:
+        raise ValueError("aggregate signature must be 96 bytes")
+    return agg_sig_bytes + bitmap
+
+
+def decode_sig_and_bitmap(payload: bytes, expected_bitmap_len: int):
+    """Split and length-check a quorum proof (reference:
+    internal/chain/sig.go:13-50 ParseCommitSigAndBitmap semantics)."""
+    if len(payload) < SIG_BYTES:
+        raise ValueError("payload shorter than a signature")
+    sig, bitmap = payload[:SIG_BYTES], payload[SIG_BYTES:]
+    if len(bitmap) != expected_bitmap_len:
+        raise ValueError(
+            f"bitmap length {len(bitmap)} != expected {expected_bitmap_len}"
+        )
+    return sig, bitmap
+
+
+class FBFTLog:
+    """In-memory store of blocks + messages per (type, blockNum, viewID,
+    hash) (reference: consensus/fbft_log.go:128-314)."""
+
+    def __init__(self):
+        self._messages: dict = {}
+        self._blocks: dict = {}
+
+    def add_message(self, msg: FBFTMessage) -> bool:
+        k = msg.key()
+        if k in self._messages:
+            return False
+        self._messages[k] = msg
+        return True
+
+    def add_block(self, block_hash: bytes, block_bytes: bytes):
+        self._blocks[block_hash] = block_bytes
+
+    def get_block(self, block_hash: bytes):
+        return self._blocks.get(block_hash)
+
+    def get_messages(
+        self, msg_type: MsgType, block_num: int | None = None,
+        view_id: int | None = None, block_hash: bytes | None = None
+    ):
+        out = []
+        for m in self._messages.values():
+            if m.msg_type != msg_type:
+                continue
+            if block_num is not None and m.block_num != block_num:
+                continue
+            if view_id is not None and m.view_id != view_id:
+                continue
+            if block_hash is not None and m.block_hash != block_hash:
+                continue
+            out.append(m)
+        return out
+
+    def prune_below(self, block_num: int):
+        """Drop messages for heights below block_num (reference:
+        fbft_log.go deleteMessagesLessThan)."""
+        self._messages = {
+            k: m for k, m in self._messages.items() if m.block_num >= block_num
+        }
+        return self

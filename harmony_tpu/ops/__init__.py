@@ -1,0 +1,16 @@
+"""Batched JAX/Pallas BLS12-381 compute path — the TPU replacement for the
+reference's herumi/mcl cgo boundary (SURVEY.md §2.1).
+
+Layout conventions (little-endian limbs, Montgomery domain):
+
+    Fp   : int32[..., 32]          32 limbs x 12 bits  (base 2^12)
+    Fp2  : int32[..., 2, 32]       c0 + c1 u
+    Fp6  : int32[..., 3, 2, 32]    c0 + c1 v + c2 v^2
+    Fp12 : int32[..., 2, 3, 2, 32] d0 + d1 w
+    G1   : int32[..., 3, 32]       Jacobian (X, Y, Z) over Fp
+    G2   : int32[..., 3, 2, 32]    Jacobian (X, Y, Z) over Fp2
+
+12-bit limbs keep every partial product and accumulator inside int32 —
+TPUs have no native 64-bit multiply.  All ops are batched over leading
+axes and jit/vmap/shard_map-compatible.
+"""

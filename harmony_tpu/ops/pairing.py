@@ -1,0 +1,185 @@
+"""Batched optimal ate pairing on TPU: Miller loop + final exponentiation.
+
+This is the op the whole framework exists for: the reference burns one
+pairing check per FBFT vote (reference: consensus/leader.go:173) and per
+block replay (reference: internal/chain/engine.go:640) inside herumi's C++
+library; here it is a batched, jittable JAX program.
+
+Algorithm (bit-for-bit the bigint twin in ref/pairing.py
+miller_loop_projective, which the tests pin against the affine ground
+truth):
+
+- Miller loop over the 63 bits of |x| as ONE lax.scan with a uniform body
+  (double-step always; add-step computed and select-masked by the bit) —
+  a single compiled body instead of 63 unrolled variants.
+- Twist-Jacobian line construction with denominator elimination; lines
+  live in the sparse Fp12 basis {v^2, w, w v}.
+- Final exponentiation: easy part via conjugate / inverse / Frobenius^2;
+  hard part is a fixed-exponent square-and-multiply over the 1509 bits of
+  (p^4 - p^2 + 1)/r.  (The x-addition-chain + cyclotomic-squaring upgrade
+  is a planned optimization; this version optimizes for a small compiled
+  graph.)
+
+Batching: points are batched over leading axes; products of pairings
+(the aggregate-verify shape) share one final exponentiation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import _constants as C
+from . import fp
+from . import towers as T
+
+_ABS_X_BITS = jnp.asarray(
+    [int(b) for b in bin(-C.BLS_X)[2:]][1:], dtype=jnp.int32
+)  # bits after the leading one, MSB first
+
+_HARD_BITS = jnp.asarray(
+    [int(b) for b in bin(C.HARD_EXP)[2:]], dtype=jnp.int32
+)
+
+
+def _fp2_scale_fp(a, s):
+    """Multiply an Fp2 element (..., 2, 32) by an Fp scalar (..., 32)."""
+    return fp.mont_mul(a, s[..., None, :])
+
+
+def _small(a, k):
+    """Multiply by a tiny integer constant via doubling chains."""
+    if k == 2:
+        return fp.add(a, a)
+    if k == 3:
+        return fp.add(fp.add(a, a), a)
+    if k == 8:
+        t2 = fp.add(a, a)
+        t4 = fp.add(t2, t2)
+        return fp.add(t4, t4)
+    raise ValueError(k)
+
+
+def _sparse_line_to_fp12(c_v2, c_w, c_wv):
+    """Assemble c_v2*v^2 + c_w*w + c_wv*(w v) into a dense Fp12 tensor."""
+    z = jnp.zeros_like(c_v2)
+    c0 = jnp.stack([z, z, c_v2], axis=-3)  # coefficients of 1, v, v^2
+    c1 = jnp.stack([c_w, c_wv, z], axis=-3)  # w, w v, w v^2
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _dbl_step(x, y, z, xp3, yp2):
+    """Twist-Jacobian doubling + tangent line at P (precomputed 3xp, 2yp)."""
+    sq = T.fp2_sqr(jnp.stack([x, y, z]))
+    xsq, ysq, zsq = sq[0], sq[1], sq[2]
+    m = T.fp2_mul(jnp.stack([zsq, xsq]), jnp.stack([z, x]))
+    z3p, x3p = m[0], m[1]  # Z^3, X^3
+    m = T.fp2_mul(
+        jnp.stack([T.fp2_add(y, y), xsq]),
+        jnp.stack([z3p, zsq]),
+    )
+    c_v2 = _fp2_scale_fp(m[0], yp2)  # 2 Y Z^3 * yp  (yp2 = yp, x2 folded)
+    c_wv = fp.neg(_fp2_scale_fp(m[1], xp3))  # -3 X^2 Z^2 * xp
+    c_w = fp.sub(_small(x3p, 3), _small(ysq, 2))  # 3 X^3 - 2 Y^2
+    # dbl-2009-l
+    b = ysq
+    csq = T.fp2_sqr(jnp.stack([b, T.fp2_add(x, b)]))
+    c, t = csq[0], csq[1]
+    d = _small(fp.sub(fp.sub(t, xsq), c), 2)
+    e = _small(xsq, 3)
+    m = T.fp2_mul(jnp.stack([e, y]), jnp.stack([e, z]))
+    f_, yz = m[0], m[1]
+    x3 = fp.sub(f_, _small(d, 2))
+    y3 = fp.sub(T.fp2_mul(e, fp.sub(d, x3)), _small(c, 8))
+    z3 = _small(yz, 2)
+    return (x3, y3, z3), (c_v2, c_w, c_wv)
+
+
+def _add_step(x, y, z, xq, yq, xp_m, yp_m):
+    """Twist-Jacobian mixed addition of the affine base Q + chord line."""
+    zsq = T.fp2_sqr(z)
+    z3p = T.fp2_mul(zsq, z)
+    m = T.fp2_mul(jnp.stack([yq, xq]), jnp.stack([z3p, zsq]))
+    s2, u2 = m[0], m[1]
+    num = fp.sub(y, s2)  # (Y - yq Z^3), negated slope numerator sense below
+    # NOTE: ref uses num = Y - yq*Z^3 with line anchored at Q
+    h = fp.sub(u2, x)
+    den = T.fp2_mul(z, fp.neg(h))  # Z (X - xq Z^2) = -Z*H
+    c_v2 = _fp2_scale_fp(den, yp_m)
+    c_wv = fp.neg(_fp2_scale_fp(num, xp_m))
+    m = T.fp2_mul(jnp.stack([xq, yq]), jnp.stack([num, den]))
+    c_w = fp.sub(m[0], m[1])
+    # madd-2007-bl (Z2 = 1)
+    r = _small(fp.sub(s2, y), 2)
+    sq = T.fp2_sqr(jnp.stack([_small(h, 2), r, T.fp2_add(z, h)]))
+    i, rsq, zh = sq[0], sq[1], sq[2]
+    m = T.fp2_mul(jnp.stack([h, x]), jnp.stack([i, i]))
+    j, v = m[0], m[1]
+    x3 = fp.sub(fp.sub(rsq, j), _small(v, 2))
+    m = T.fp2_mul(jnp.stack([r, y]), jnp.stack([fp.sub(v, x3), j]))
+    y3 = fp.sub(m[0], _small(m[1], 2))
+    z3 = fp.sub(fp.sub(zh, zsq), T.fp2_sqr(h))
+    return (x3, y3, z3), (c_v2, c_w, c_wv)
+
+
+def miller_loop(p_aff, q_aff):
+    """f_{|x|,Q}(P), conjugated for x < 0.  Finite affine inputs only:
+    p_aff (..., 2, 32) over Fp, q_aff (..., 2, 2, 32) over Fp2."""
+    xp = p_aff[..., 0, :]
+    yp = p_aff[..., 1, :]
+    xq = q_aff[..., 0, :, :]
+    yq = q_aff[..., 1, :, :]
+    xp3 = _small(xp, 3)
+    batch = xp.shape[:-1]
+
+    def step(carry, bit):
+        f, x, y, z = carry
+        (x, y, z), (c_v2, c_w, c_wv) = _dbl_step(x, y, z, xp3, yp)
+        f = T.fp12_mul(T.fp12_sqr(f), _sparse_line_to_fp12(c_v2, c_w, c_wv))
+        (xa, ya, za), (a_v2, a_w, a_wv) = _add_step(x, y, z, xq, yq, xp, yp)
+        fa = T.fp12_mul(f, _sparse_line_to_fp12(a_v2, a_w, a_wv))
+        take = bit == 1
+        f = jnp.where(take, fa, f)
+        x = jnp.where(take, xa, x)
+        y = jnp.where(take, ya, y)
+        z = jnp.where(take, za, z)
+        return (f, x, y, z), None
+
+    f0 = T.fp12_one(batch)
+    one2 = T.fp2_one(batch)
+    carry, _ = jax.lax.scan(step, (f0, xq, yq, one2), _ABS_X_BITS)
+    return T.fp12_conj(carry[0])
+
+
+def final_exponentiation(f):
+    """f^((p^12-1)/r): easy part exactly, hard part by fixed-exponent pow."""
+    f1 = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))  # ^(p^6 - 1)
+    f2 = T.fp12_mul(T.fp12_frobenius(f1, 2), f1)  # ^(p^2 + 1)
+    return T.fp12_pow(f2, _HARD_BITS)
+
+
+def pairing(p_aff, q_aff):
+    """Batched full pairing e(P, Q)."""
+    return final_exponentiation(miller_loop(p_aff, q_aff))
+
+
+def pairing_product(p_aff, q_aff):
+    """prod_k e(P_k, Q_k) over the FIRST axis, one shared final
+    exponentiation — the aggregate-verify shape (reference:
+    internal/chain/engine.go:619-642 does exactly two such pairings per
+    block; batch replay does many)."""
+    fs = miller_loop(p_aff, q_aff)  # (K, ..., fp12)
+    while fs.shape[0] > 1:
+        k = fs.shape[0]
+        half = k // 2
+        merged = T.fp12_mul(fs[:half], fs[half : 2 * half])
+        fs = (
+            jnp.concatenate([merged, fs[2 * half :]], axis=0)
+            if k % 2
+            else merged
+        )
+    return final_exponentiation(fs[0])
+
+
+def is_one(gt):
+    """Boolean mask: GT element == 1 (canonical Montgomery digits)."""
+    one = T.fp12_one(gt.shape[:-4])
+    return jnp.all(gt == one, axis=(-1, -2, -3, -4))

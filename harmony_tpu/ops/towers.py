@@ -1,0 +1,272 @@
+"""Batched tower fields Fp2 / Fp6 / Fp12 over the limb substrate (ops/fp.py).
+
+Tower (same as the reference's mcl build and harmony_tpu.ref.fields):
+
+    Fp2  = Fp [u] / (u^2 + 1)          tensor (..., 2, 32)
+    Fp6  = Fp2[v] / (v^3 - (u+1))      tensor (..., 3, 2, 32)
+    Fp12 = Fp6[w] / (w^2 - v)          tensor (..., 2, 3, 2, 32)
+
+TPU-shaping trick: every mul at every level is Karatsuba with *independent*
+sub-products, and each level is written shape-polymorphically, so the
+sub-products stack onto a new leading axis.  A single Fp12 multiplication
+therefore reaches ops/fp.py as ONE mont_mul call on a (3, 6, 3, ..., 32)
+stack — 54 Fp products in one fused scan, keeping the VPU wide instead of
+dispatching 54 tiny kernels.
+
+Montgomery domain throughout.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import _constants as C
+from . import fp
+
+# --- Fp2 -------------------------------------------------------------------
+
+
+def fp2_add(a, b):
+    return fp.add(a, b)
+
+
+def fp2_sub(a, b):
+    return fp.sub(a, b)
+
+
+def fp2_neg(a):
+    return fp.neg(a)
+
+
+def _split2(a):
+    return a[..., 0, :], a[..., 1, :]
+
+
+def fp2_mul(a, b):
+    """Karatsuba: 3 stacked Fp muls."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1 = _split2(a)
+    b0, b1 = _split2(b)
+    lhs = jnp.stack([a0, a1, fp.add(a0, a1)], axis=0)
+    rhs = jnp.stack([b0, b1, fp.add(b0, b1)], axis=0)
+    v = fp.mont_mul(lhs, rhs)
+    c0 = fp.sub(v[0], v[1])
+    c1 = fp.sub(v[2], fp.add(v[0], v[1]))
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fp2_sqr(a):
+    """Complex squaring: (a0+a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u —
+    2 stacked Fp muls."""
+    a0, a1 = _split2(a)
+    lhs = jnp.stack([fp.add(a0, a1), a0], axis=0)
+    rhs = jnp.stack([fp.sub(a0, a1), fp.add(a1, a1)], axis=0)
+    v = fp.mont_mul(lhs, rhs)
+    return jnp.stack([v[0], v[1]], axis=-2)
+
+
+def fp2_conj(a):
+    a0, a1 = _split2(a)
+    return jnp.stack([a0, fp.neg(a1)], axis=-2)
+
+
+def fp2_mul_xi(a):
+    """Multiply by xi = u + 1: (a0 - a1) + (a0 + a1) u."""
+    a0, a1 = _split2(a)
+    return jnp.stack([fp.sub(a0, a1), fp.add(a0, a1)], axis=-2)
+
+
+def fp2_inv(a):
+    a0, a1 = _split2(a)
+    sq = fp.mont_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
+    ninv = fp.inv(fp.add(sq[0], sq[1]))
+    prod = fp.mont_mul(jnp.stack([a0, a1]), jnp.stack([ninv, ninv]))
+    return jnp.stack([prod[0], fp.neg(prod[1])], axis=-2)
+
+
+def fp2_zero(batch_shape=()):
+    return jnp.zeros((*batch_shape, 2, fp.N_LIMBS), dtype=jnp.int32)
+
+
+def fp2_one(batch_shape=()):
+    one = jnp.broadcast_to(fp.ONE_MONT, (*batch_shape, fp.N_LIMBS))
+    return jnp.stack([one, jnp.zeros_like(one)], axis=-2)
+
+
+def fp2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fp2_select(mask, x, y):
+    return jnp.where(mask[..., None, None], x, y)
+
+
+# --- Fp6 -------------------------------------------------------------------
+
+
+def _split3(a):
+    return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+
+def fp6_add(a, b):
+    return fp.add(a, b)
+
+
+def fp6_sub(a, b):
+    return fp.sub(a, b)
+
+
+def fp6_neg(a):
+    return fp.neg(a)
+
+
+def fp6_mul(a, b):
+    """Karatsuba-3: 6 stacked Fp2 muls (18 Fp muls in one scan)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1, a2 = _split3(a)
+    b0, b1, b2 = _split3(b)
+    lhs = jnp.stack(
+        [a0, a1, a2, fp.add(a1, a2), fp.add(a0, a1), fp.add(a0, a2)], axis=0
+    )
+    rhs = jnp.stack(
+        [b0, b1, b2, fp.add(b1, b2), fp.add(b0, b1), fp.add(b0, b2)], axis=0
+    )
+    v = fp2_mul(lhs, rhs)
+    v0, v1, v2, v12, v01, v02 = (v[i] for i in range(6))
+    c0 = fp.add(v0, fp2_mul_xi(fp.sub(v12, fp.add(v1, v2))))
+    c1 = fp.add(fp.sub(v01, fp.add(v0, v1)), fp2_mul_xi(v2))
+    c2 = fp.add(fp.sub(v02, fp.add(v0, v2)), v1)
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_mul_v(a):
+    """Multiply by v: (c0, c1, c2) -> (xi c2, c0, c1)."""
+    a0, a1, a2 = _split3(a)
+    return jnp.stack([fp2_mul_xi(a2), a0, a1], axis=-3)
+
+
+def fp6_inv(a):
+    a0, a1, a2 = _split3(a)
+    sq = fp2_mul(jnp.stack([a0, a2, a1]), jnp.stack([a0, a2, a1]))
+    cr = fp2_mul(jnp.stack([a1, a0, a0]), jnp.stack([a2, a1, a2]))
+    t0 = fp.sub(sq[0], fp2_mul_xi(cr[0]))  # a0^2 - xi a1 a2
+    t1 = fp.sub(fp2_mul_xi(sq[1]), cr[1])  # xi a2^2 - a0 a1
+    t2 = fp.sub(sq[2], cr[2])  # a1^2 - a0 a2
+    m = fp2_mul(jnp.stack([a0, a2, a1]), jnp.stack([t0, t1, t2]))
+    norm = fp.add(m[0], fp2_mul_xi(fp.add(m[1], m[2])))
+    ninv = fp2_inv(norm)
+    out = fp2_mul(jnp.stack([t0, t1, t2]), jnp.stack([ninv, ninv, ninv]))
+    return jnp.stack([out[0], out[1], out[2]], axis=-3)
+
+
+def fp6_zero(batch_shape=()):
+    return jnp.zeros((*batch_shape, 3, 2, fp.N_LIMBS), dtype=jnp.int32)
+
+
+def fp6_one(batch_shape=()):
+    return jnp.stack(
+        [fp2_one(batch_shape), fp2_zero(batch_shape), fp2_zero(batch_shape)],
+        axis=-3,
+    )
+
+
+# --- Fp12 ------------------------------------------------------------------
+
+
+def _split12(a):
+    return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+
+def fp12_add(a, b):
+    return fp.add(a, b)
+
+
+def fp12_sub(a, b):
+    return fp.sub(a, b)
+
+
+def fp12_mul(a, b):
+    """Karatsuba-2 over Fp6: 3 stacked Fp6 muls = one 54-product scan."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1 = _split12(a)
+    b0, b1 = _split12(b)
+    lhs = jnp.stack([a0, a1, fp.add(a0, a1)], axis=0)
+    rhs = jnp.stack([b0, b1, fp.add(b0, b1)], axis=0)
+    v = fp6_mul(lhs, rhs)
+    c0 = fp.add(v[0], fp6_mul_v(v[1]))  # w^2 = v
+    c1 = fp.sub(v[2], fp.add(v[0], v[1]))
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    """x -> x^(p^6): negate the w coefficient."""
+    a0, a1 = _split12(a)
+    return jnp.stack([a0, fp.neg(a1)], axis=-4)
+
+
+def fp12_inv(a):
+    a0, a1 = _split12(a)
+    sq = fp6_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
+    norm = fp.sub(sq[0], fp6_mul_v(sq[1]))
+    ninv = fp6_inv(norm)
+    out = fp6_mul(jnp.stack([a0, a1]), jnp.stack([ninv, ninv]))
+    return jnp.stack([out[0], fp6_neg(out[1])], axis=-4)
+
+
+def fp12_zero(batch_shape=()):
+    return jnp.zeros((*batch_shape, 2, 3, 2, fp.N_LIMBS), dtype=jnp.int32)
+
+
+def fp12_one(batch_shape=()):
+    return jnp.stack([fp6_one(batch_shape), fp6_zero(batch_shape)], axis=-4)
+
+
+def fp12_select(mask, x, y):
+    return jnp.where(mask[..., None, None, None, None], x, y)
+
+
+# --- Frobenius -------------------------------------------------------------
+
+# FROB_GAMMA[k-1][m] = xi^(m (p^k - 1)/6) as Fp2; coefficient of w^i v^j
+# gets multiplied by gamma_k[i + 2 j] after k-fold conjugation.
+_GAMMA = jnp.asarray(np.array(C.FROB_GAMMA, dtype=np.int32))  # (3, 6, 2, 32)
+
+# rearrange to (k, i_w, j_v, 2, 32) with m = i + 2 j
+_GAMMA_TENSOR = jnp.stack(
+    [
+        jnp.stack([_GAMMA[:, 0 + 2 * j] for j in range(3)], axis=1),  # i=0
+        jnp.stack([_GAMMA[:, 1 + 2 * j] for j in range(3)], axis=1),  # i=1
+    ],
+    axis=1,
+)  # (3, 2, 3, 2, 32)
+
+
+def fp12_frobenius(a, k=1):
+    """a^(p^k) for k = 1, 2, 3 via precomputed gamma constants."""
+    if k not in (1, 2, 3):
+        raise ValueError("frobenius power must be 1, 2 or 3")
+    if k % 2 == 1:
+        # conjugate every Fp2 coefficient (negate u-part)
+        a0 = a[..., 0:1, :]
+        a1 = fp.neg(a[..., 1:2, :])
+        a = jnp.concatenate([a0, a1], axis=-2)
+    return fp2_mul(a, _GAMMA_TENSOR[k - 1])
+
+
+def fp12_pow(a, exponent_bits):
+    """a^e for a static MSB-first bit array (select-based, scan)."""
+    import jax
+
+    bits = jnp.asarray(exponent_bits, dtype=jnp.int32)
+
+    def step(acc, bit):
+        acc = fp12_sqr(acc)
+        acc = jnp.where(bit == 1, fp12_mul(acc, a), acc)
+        return acc, None
+
+    batch = a.shape[:-4]
+    acc, _ = jax.lax.scan(step, fp12_one(batch), bits)
+    return acc

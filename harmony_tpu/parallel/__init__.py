@@ -1,0 +1,13 @@
+"""Device-mesh parallelism for the BLS pipeline.
+
+The reference scales consensus crypto over committee size and shard count
+(SURVEY.md §2.7); here those axes map onto a JAX device mesh:
+
+- independent verifies (block replay, per-vote checks) shard over the
+  batch axis — pure data parallelism via sharding annotations;
+- committee aggregation (masked G1 sums over 1000+ validators) shards the
+  committee axis via shard_map, with an all_gather of per-device partial
+  sums and a log-depth merge — the collective rides ICI;
+- products of pairings shard the pair axis, combining per-device Miller
+  products before one replicated final exponentiation.
+"""

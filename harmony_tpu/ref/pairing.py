@@ -82,6 +82,106 @@ def pairing(p_pt, q_pt):
     return final_exponentiation(miller_loop(p_pt, q_pt))
 
 
+# --- projective-twist Miller loop (the TPU algorithm, validated here) ------
+#
+# The TPU kernel (ops/pairing.py) cannot afford per-step inversions, so it
+# works on the twist in Jacobian coordinates with denominator-eliminated
+# line functions.  Lines are scaled by arbitrary Fp2 factors (killed by the
+# final exponentiation) and expressed in the sparse basis {v^2, w, w v}:
+#
+#   line*v^2 = yp*v^2 - (lambda xp)*(w v) + (lambda x_T - y_T)*w
+#
+# with, after clearing Jacobian denominators (T = (X, Y, Z), x = X/Z^2):
+#   dbl:  c_v2 = 2 Y Z^3 yp,  c_w = 3 X^3 - 2 Y^2,  c_wv = -3 X^2 Z^2 xp
+#   add:  c_v2 = yp Z (X - xq Z^2),  c_wv = -xp (Y - yq Z^3),
+#         c_w = xq (Y - yq Z^3) - yq Z (X - xq Z^2)
+#
+# This bigint twin exists so the TPU implementation can be debugged
+# step-by-step against exact integers; test_ref_pairing_bls.py checks it
+# agrees with the affine miller_loop after final exponentiation.
+
+
+def _sparse_line_to_fp12(c_v2, c_w, c_wv):
+    """Assemble c_v2*v^2 + c_w*w + c_wv*w*v as a full Fp12 element."""
+    c0 = (F.FP2_ZERO, F.FP2_ZERO, c_v2)  # 1, v, v^2
+    c1 = (c_w, c_wv, F.FP2_ZERO)  # w, w v, w v^2
+    return (c0, c1)
+
+
+def miller_loop_projective(p_pt, q_pt):
+    """f_{|x|,Q}(P) with twist-Jacobian steps; equals miller_loop up to
+    subfield factors (identical pairing after final exponentiation)."""
+    if p_pt is None or q_pt is None:
+        return F.FP12_ONE
+    xp, yp = p_pt
+    xq, yq = q_pt
+    x, y, z = xq, yq, F.FP2_ONE  # Jacobian T = Q
+
+    def dbl_step(x, y, z):
+        # line coefficients
+        zsq = F.fp2_sqr(z)
+        z3 = F.fp2_mul(zsq, z)
+        xsq = F.fp2_sqr(x)
+        ysq = F.fp2_sqr(y)
+        c_v2 = F.fp2_scalar(F.fp2_mul(y, z3), 2 * yp % P)
+        c_w = F.fp2_sub(
+            F.fp2_scalar(F.fp2_mul(xsq, x), 3), F.fp2_scalar(ysq, 2)
+        )
+        c_wv = F.fp2_neg(F.fp2_scalar(F.fp2_mul(xsq, zsq), 3 * xp % P))
+        # dbl-2009-l
+        a = xsq
+        b = ysq
+        c = F.fp2_sqr(b)
+        d = F.fp2_scalar(
+            F.fp2_sub(F.fp2_sub(F.fp2_sqr(F.fp2_add(x, b)), a), c), 2
+        )
+        e = F.fp2_scalar(a, 3)
+        f_ = F.fp2_sqr(e)
+        x3 = F.fp2_sub(f_, F.fp2_scalar(d, 2))
+        y3 = F.fp2_sub(F.fp2_mul(e, F.fp2_sub(d, x3)), F.fp2_scalar(c, 8))
+        z3_ = F.fp2_scalar(F.fp2_mul(y, z), 2)
+        return (x3, y3, z3_), (c_v2, c_w, c_wv)
+
+    def add_step(x, y, z):
+        zsq = F.fp2_sqr(z)
+        z3 = F.fp2_mul(zsq, z)
+        num = F.fp2_sub(y, F.fp2_mul(yq, z3))  # Y - yq Z^3
+        den = F.fp2_mul(z, F.fp2_sub(x, F.fp2_mul(xq, zsq)))  # Z(X - xq Z^2)
+        c_v2 = F.fp2_scalar(den, yp)
+        c_wv = F.fp2_neg(F.fp2_scalar(num, xp))
+        c_w = F.fp2_sub(F.fp2_mul(xq, num), F.fp2_mul(yq, den))
+        # Jacobian + affine (add-2007-bl with Z2 = 1)
+        u2 = F.fp2_mul(xq, zsq)
+        s2 = F.fp2_mul(yq, z3)
+        h = F.fp2_sub(u2, x)
+        r = F.fp2_scalar(F.fp2_sub(s2, y), 2)
+        i = F.fp2_sqr(F.fp2_scalar(h, 2))
+        j = F.fp2_mul(h, i)
+        v = F.fp2_mul(x, i)
+        x3 = F.fp2_sub(F.fp2_sub(F.fp2_sqr(r), j), F.fp2_scalar(v, 2))
+        y3 = F.fp2_sub(
+            F.fp2_mul(r, F.fp2_sub(v, x3)),
+            F.fp2_scalar(F.fp2_mul(y, j), 2),
+        )
+        z3_ = F.fp2_sub(
+            F.fp2_sub(F.fp2_sqr(F.fp2_add(z, h)), zsq), F.fp2_sqr(h)
+        )
+        return (x3, y3, z3_), (c_v2, c_w, c_wv)
+
+    f = F.FP12_ONE
+    for bit in _ABS_X_BITS[1:]:
+        (x, y, z), (c_v2, c_w, c_wv) = dbl_step(x, y, z)
+        f = F.fp12_mul(F.fp12_sqr(f), _sparse_line_to_fp12(c_v2, c_w, c_wv))
+        if bit == "1":
+            (x, y, z), (c_v2, c_w, c_wv) = add_step(x, y, z)
+            f = F.fp12_mul(f, _sparse_line_to_fp12(c_v2, c_w, c_wv))
+    return F.fp12_conj(f)  # x < 0
+
+
+def pairing_projective(p_pt, q_pt):
+    return final_exponentiation(miller_loop_projective(p_pt, q_pt))
+
+
 def multi_pairing(pairs):
     """prod_i e(P_i, Q_i): shared final exponentiation over the products of
     Miller loops — the structure the TPU batch-verify kernel exploits."""

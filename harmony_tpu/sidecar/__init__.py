@@ -1,0 +1,12 @@
+"""Localhost sidecar: the process boundary between a host node (the Go
+chain client in deployment) and the TPU kernel server.
+
+The reference crosses from Go into herumi C++ via cgo in-process; the TPU
+equivalent is a local socket hop into a persistent kernel server holding
+compiled executables and epoch-keyed device-resident committee tables
+(SURVEY.md §7.3).  gRPC is not available in this image, so the wire
+format is a compact length-prefixed binary protocol (protocol.py) served
+over TCP/Unix sockets (server.py), with both a Python client (client.py)
+and a native C++ client library (native/sidecar_client.cpp) for embedding
+in non-Python nodes.
+"""

@@ -1,0 +1,70 @@
+"""Python sidecar client (tests + Python-side nodes).  The C++ twin for
+non-Python hosts lives in native/sidecar_client.cpp."""
+
+from __future__ import annotations
+
+import socket
+
+from . import protocol as P
+
+
+class SidecarClient:
+    def __init__(self, address):
+        if isinstance(address, str):
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.connect(address)
+        self._req_id = 0
+
+    def close(self):
+        self._sock.close()
+
+    def _call(self, msg_type: int, body: bytes):
+        self._req_id += 1
+        self._sock.sendall(P.pack_frame(msg_type, self._req_id, body))
+        frame = P.read_frame(self._sock)
+        if frame is None:
+            raise ConnectionError("sidecar closed connection")
+        rtype, rid, rbody = frame
+        if rtype != (msg_type | P.RESP_FLAG) or rid != self._req_id:
+            raise ValueError("response mismatch")
+        if not rbody:
+            raise ValueError("empty response")
+        return rbody[0], rbody[1:]
+
+    def ping(self) -> int:
+        status, body = self._call(P.MSG_PING, b"")
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"ping failed: {status}")
+        return int.from_bytes(body[:2], "little")
+
+    def set_committee(self, epoch: int, shard: int, pubkeys: list):
+        status, _ = self._call(
+            P.MSG_SET_COMMITTEE, P.build_set_committee(epoch, shard, pubkeys)
+        )
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"set_committee failed: {status}")
+
+    def agg_verify(
+        self, epoch: int, shard: int, payload: bytes, bitmap: bytes,
+        sig: bytes,
+    ) -> bool:
+        status, body = self._call(
+            P.MSG_AGG_VERIFY,
+            P.build_agg_verify(epoch, shard, payload, bitmap, sig),
+        )
+        if status == P.STATUS_UNKNOWN_COMMITTEE:
+            raise KeyError(f"no committee for epoch {epoch} shard {shard}")
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"agg_verify failed: {status}")
+        return bool(body[0])
+
+    def verify_batch(self, items: list) -> list:
+        status, body = self._call(
+            P.MSG_VERIFY_BATCH, P.build_verify_batch(items)
+        )
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"verify_batch failed: {status}")
+        n = int.from_bytes(body[:4], "little")
+        return [bool(b) for b in body[4 : 4 + n]]

@@ -1,0 +1,165 @@
+"""Sidecar wire protocol v1: length-prefixed binary frames.
+
+Frame layout (little-endian):
+
+    [u32 frame_len] [u8 msg_type] [u32 request_id] [body ...]
+
+frame_len counts everything after itself.  Responses echo request_id and
+set bit 7 of msg_type; body starts with a u8 status (0 = OK).
+
+Message bodies:
+
+    PING          -> empty; response body: protocol version u16
+    SET_COMMITTEE -> u64 epoch, u32 shard, u32 n, n * 48B pubkeys
+                     (the epoch-keyed device table upload; steady-state
+                     requests then carry only bitmaps + signatures,
+                     SURVEY.md §7.3)
+    AGG_VERIFY    -> u64 epoch, u32 shard, u16 payload_len, payload,
+                     u16 bitmap_len, bitmap, 96B aggregate signature
+                     response: u8 ok
+    VERIFY_BATCH  -> u32 n, n * (48B pubkey, u16 payload_len, payload,
+                     96B signature); response: u32 n, n * u8 ok
+
+Max frame 2 MB — mirroring the reference's libp2p message cap
+(reference: p2p/host.go:98-99).
+"""
+
+from __future__ import annotations
+
+import struct
+
+VERSION = 1
+MAX_FRAME = 2 * 1024 * 1024
+
+MSG_PING = 0x01
+MSG_SET_COMMITTEE = 0x02
+MSG_AGG_VERIFY = 0x03
+MSG_VERIFY_BATCH = 0x04
+RESP_FLAG = 0x80
+
+STATUS_OK = 0
+STATUS_ERROR = 1
+STATUS_UNKNOWN_COMMITTEE = 2
+STATUS_BAD_REQUEST = 3
+
+
+def pack_frame(msg_type: int, request_id: int, body: bytes) -> bytes:
+    frame_len = 1 + 4 + len(body)
+    if frame_len > MAX_FRAME:
+        raise ValueError("frame too large")
+    return struct.pack("<IBI", frame_len, msg_type, request_id) + body
+
+
+def unpack_frame(data: bytes):
+    """(msg_type, request_id, body) from one complete frame (sans length)."""
+    if len(data) < 5:
+        raise ValueError("short frame")
+    msg_type, request_id = struct.unpack_from("<BI", data)
+    return msg_type, request_id, data[5:]
+
+
+def read_frame(sock):
+    """Blocking read of one frame from a socket; None on clean EOF."""
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (frame_len,) = struct.unpack("<I", hdr)
+    if not 5 <= frame_len <= MAX_FRAME:
+        raise ValueError(f"bad frame length {frame_len}")
+    data = _read_exact(sock, frame_len)
+    if data is None:
+        raise ValueError("truncated frame")
+    return unpack_frame(data)
+
+
+def _read_exact(sock, n: int):
+    """Read exactly n bytes; None on clean EOF at a frame boundary,
+    ValueError if the stream dies mid-read (truncation is an error)."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise ValueError("stream truncated mid-read")
+        buf += chunk
+    return bytes(buf)
+
+
+# --- body builders/parsers -------------------------------------------------
+
+
+def build_set_committee(epoch: int, shard: int, pubkeys: list) -> bytes:
+    body = struct.pack("<QII", epoch, shard, len(pubkeys))
+    for pk in pubkeys:
+        if len(pk) != 48:
+            raise ValueError("pubkey must be 48 bytes")
+        body += pk
+    return body
+
+
+def parse_set_committee(body: bytes):
+    epoch, shard, n = struct.unpack_from("<QII", body)
+    off = 16
+    if len(body) != off + 48 * n:
+        raise ValueError("bad SET_COMMITTEE length")
+    keys = [body[off + 48 * i : off + 48 * (i + 1)] for i in range(n)]
+    return epoch, shard, keys
+
+
+def build_agg_verify(
+    epoch: int, shard: int, payload: bytes, bitmap: bytes, sig: bytes
+) -> bytes:
+    if len(sig) != 96:
+        raise ValueError("signature must be 96 bytes")
+    return (
+        struct.pack("<QIH", epoch, shard, len(payload))
+        + payload
+        + struct.pack("<H", len(bitmap))
+        + bitmap
+        + sig
+    )
+
+
+def parse_agg_verify(body: bytes):
+    epoch, shard, plen = struct.unpack_from("<QIH", body)
+    off = 14
+    payload = body[off : off + plen]
+    off += plen
+    (blen,) = struct.unpack_from("<H", body, off)
+    off += 2
+    bitmap = body[off : off + blen]
+    off += blen
+    sig = body[off : off + 96]
+    if len(sig) != 96 or off + 96 != len(body):
+        raise ValueError("bad AGG_VERIFY length")
+    return epoch, shard, payload, bitmap, sig
+
+
+def build_verify_batch(items: list) -> bytes:
+    """items: [(pubkey48, payload, sig96)]"""
+    body = struct.pack("<I", len(items))
+    for pk, payload, sig in items:
+        if len(pk) != 48 or len(sig) != 96:
+            raise ValueError("bad item sizes")
+        body += pk + struct.pack("<H", len(payload)) + payload + sig
+    return body
+
+
+def parse_verify_batch(body: bytes):
+    (n,) = struct.unpack_from("<I", body)
+    off = 4
+    items = []
+    for _ in range(n):
+        pk = body[off : off + 48]
+        off += 48
+        (plen,) = struct.unpack_from("<H", body, off)
+        off += 2
+        payload = body[off : off + plen]
+        off += plen
+        sig = body[off : off + 96]
+        off += 96
+        items.append((pk, payload, sig))
+    if off != len(body):
+        raise ValueError("bad VERIFY_BATCH length")
+    return items

@@ -1,0 +1,178 @@
+"""Sidecar kernel server: accepts verification batches over a local
+socket, executes them on the accelerator, keeps committees device-resident.
+
+Deployment analog of the reference's in-process cgo boundary: the node
+(Go, or the Python harness in tests) ships [bitmap || sig || payload]
+requests; the server holds the epoch-keyed committee pubkey tables on
+device so steady-state traffic is O(bitmap + 96 B) per check
+(SURVEY.md §7.3 latency budget).
+
+Single-threaded request execution (JAX dispatch is serialized anyway)
+with a threaded accept loop; supports TCP and Unix sockets.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..ref import bls as RB
+from ..ref.hash_to_curve import hash_to_g2
+from . import protocol as P
+
+
+class CommitteeTable:
+    """Device-resident committee: pubkey tensor + host metadata."""
+
+    def __init__(self, pubkeys: list):
+        import jax.numpy as jnp
+
+        from ..ops import interop as I
+
+        self.serialized = list(pubkeys)
+        pts = [RB.pubkey_from_bytes(pk) for pk in pubkeys]
+        self.points = pts
+        self.device_aff = jnp.asarray(I.g1_batch_affine(pts))
+
+    def __len__(self):
+        return len(self.serialized)
+
+
+class SidecarServer:
+    def __init__(self, host="127.0.0.1", port=0, unix_path=None):
+        self._committees: dict = {}
+        self._lock = threading.Lock()
+        self._exec_lock = threading.Lock()
+        if unix_path:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(unix_path)
+            self.address = unix_path
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self.address = self._sock.getsockname()
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        self._thread = None
+
+    # --- lifecycle ---
+    def start(self):
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                frame = P.read_frame(conn)
+                if frame is None:
+                    return
+                msg_type, req_id, body = frame
+                status, resp = self._dispatch(msg_type, body)
+                conn.sendall(
+                    P.pack_frame(
+                        msg_type | P.RESP_FLAG, req_id, bytes([status]) + resp
+                    )
+                )
+        except (ValueError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # --- request handling ---
+    def _dispatch(self, msg_type: int, body: bytes):
+        try:
+            if msg_type == P.MSG_PING:
+                return P.STATUS_OK, P.VERSION.to_bytes(2, "little")
+            if msg_type == P.MSG_SET_COMMITTEE:
+                return self._on_set_committee(body)
+            if msg_type == P.MSG_AGG_VERIFY:
+                return self._on_agg_verify(body)
+            if msg_type == P.MSG_VERIFY_BATCH:
+                return self._on_verify_batch(body)
+            return P.STATUS_BAD_REQUEST, b""
+        except (ValueError, struct.error):
+            # struct.error is NOT a ValueError subclass; short bodies in
+            # the parsers raise it and must map to BAD_REQUEST, not kill
+            # the connection
+            return P.STATUS_BAD_REQUEST, b""
+
+    def _on_set_committee(self, body):
+        epoch, shard, keys = P.parse_set_committee(body)
+        table = CommitteeTable(keys)
+        with self._lock:
+            self._committees[(epoch, shard)] = table
+        return P.STATUS_OK, b""
+
+    def _get_table(self, epoch, shard):
+        with self._lock:
+            return self._committees.get((epoch, shard))
+
+    def _on_agg_verify(self, body):
+        epoch, shard, payload, bitmap, sig = P.parse_agg_verify(body)
+        table = self._get_table(epoch, shard)
+        if table is None:
+            return P.STATUS_UNKNOWN_COMMITTEE, b""
+        n = len(table)
+        if len(bitmap) != (n + 7) >> 3:
+            return P.STATUS_BAD_REQUEST, b""
+        bits = [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]
+        with self._exec_lock:
+            ok = self._agg_verify_device(table, bits, payload, sig)
+        return P.STATUS_OK, bytes([1 if ok else 0])
+
+    def _agg_verify_device(self, table, bits, payload, sig_bytes):
+        import jax.numpy as jnp
+
+        from ..ops import bls as OB
+        from ..ops import interop as I
+
+        try:
+            sig = RB.sig_from_bytes(sig_bytes)
+        except ValueError:
+            return False
+        if sig is None:
+            return False
+        h = hash_to_g2(payload)
+        h_aff = jnp.asarray(I.g2_affine_to_arr(h))
+        s_aff = jnp.asarray(I.g2_affine_to_arr(sig))
+        return bool(
+            OB.agg_verify(
+                table.device_aff, jnp.asarray(bits, dtype=jnp.int32),
+                h_aff, s_aff,
+            )
+        )
+
+    def _on_verify_batch(self, body):
+        items = P.parse_verify_batch(body)
+        results = bytearray()
+        with self._exec_lock:
+            for pk_bytes, payload, sig_bytes in items:
+                ok = False
+                try:
+                    pk = RB.pubkey_from_bytes(pk_bytes)
+                    sig = RB.sig_from_bytes(sig_bytes)
+                    ok = RB.verify(pk, payload, sig)
+                except ValueError:
+                    ok = False
+                results.append(1 if ok else 0)
+        return P.STATUS_OK, len(items).to_bytes(4, "little") + bytes(results)

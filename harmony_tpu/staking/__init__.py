@@ -1,0 +1,2 @@
+"""Staking subsystem: EPoS effective-stake election math and validator
+availability bookkeeping (reference: staking/ — SURVEY.md §2.4)."""

@@ -1,0 +1,130 @@
+"""Double-sign slashing: evidence records and verification.
+
+Behavioral parity with the reference (reference:
+staking/slash/double-sign.go:32-75 record shape, :119-274 Verify;
+consensus/double_sign.go:16-135 detection):
+
+Evidence = two conflicting ballots (different block hashes, same height/
+view) with overlapping signer keys; verification checks the conflict, the
+signer overlap, committee membership, and BOTH ballot signatures against
+the correct phase payload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import bls as B
+from ..consensus.signature import construct_commit_payload
+from ..ref import bls as RB
+
+
+@dataclass
+class Vote:
+    """One of the conflicting votes (double-sign.go:45-50)."""
+
+    signer_pubkeys: list  # serialized 48B keys
+    block_header_hash: bytes
+    signature: bytes  # 96B aggregate over the commit payload
+
+
+@dataclass
+class Moment:
+    epoch: int
+    shard_id: int
+    height: int
+    view_id: int
+
+
+@dataclass
+class Evidence:
+    moment: Moment
+    first_vote: Vote
+    second_vote: Vote
+    offender: bytes  # validator address
+
+
+@dataclass
+class Record:
+    evidence: Evidence
+    reporter: bytes
+
+
+class SlashVerifyError(ValueError):
+    pass
+
+
+def detect_double_sign(
+    existing_ballots: dict, new_key: bytes, new_hash: bytes
+) -> bytes | None:
+    """Leader-side detection (consensus/double_sign.go:16): a second vote
+    by `new_key` for a different hash at the same (height, view).
+    `existing_ballots` maps signer key -> block hash already voted."""
+    prev = existing_ballots.get(new_key)
+    if prev is not None and prev != new_hash:
+        return prev
+    return None
+
+
+def verify_record(
+    record: Record, committee_keys: list, is_staking: bool = True
+) -> None:
+    """Raises SlashVerifyError unless the evidence holds
+    (double-sign.go:119-274, minus chain-state lookups which live with
+    the caller)."""
+    ev = record.evidence
+    first, second = ev.first_vote, ev.second_vote
+
+    if ev.offender == record.reporter:
+        raise SlashVerifyError("reporter and offender are the same")
+    for pk in first.signer_pubkeys + second.signer_pubkeys:
+        if len(pk) != 48:
+            raise SlashVerifyError("signer key not 48 bytes")
+    if first.block_header_hash == second.block_header_hash:
+        raise SlashVerifyError("votes do not conflict")
+
+    overlap = [
+        k1
+        for k1 in first.signer_pubkeys
+        if any(k1 == k2 for k2 in second.signer_pubkeys)
+    ]
+    if not overlap:
+        raise SlashVerifyError("no matching double-sign keys")
+    committee = set(committee_keys)
+    for k in overlap:
+        if k not in committee:
+            raise SlashVerifyError("double-sign key not in committee")
+
+    for vote in (first, second):
+        payload = construct_commit_payload(
+            vote.block_header_hash, ev.moment.height, ev.moment.view_id,
+            is_staking,
+        )
+        agg_pk = None
+        for pk_bytes in vote.signer_pubkeys:
+            pk = B.pubkey_from_bytes_cached(pk_bytes)
+            agg_pk = pk if agg_pk is None else agg_pk.add(pk)
+        sig = B.Signature.from_bytes(vote.signature)
+        if not RB.verify(agg_pk.point, payload, sig.point):
+            raise SlashVerifyError("ballot signature invalid")
+
+
+@dataclass
+class Application:
+    """Slash application outcome (double-sign.go:62-66)."""
+
+    total_slashed: int = 0
+    total_beneficiary_reward: int = 0
+
+
+def apply_slash(
+    stake: int, rate_num: int = 2, rate_den: int = 100, reward_share_den: int = 2
+) -> Application:
+    """Economic application: slash rate of the offender's stake, half of
+    the slashed amount rewards the reporter (the reference's
+    applySlashRate/Apply shape, double-sign.go:445+)."""
+    slashed = stake * rate_num // rate_den
+    return Application(
+        total_slashed=slashed,
+        total_beneficiary_reward=slashed // reward_share_den,
+    )

@@ -1,15 +1,27 @@
-"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+"""Test configuration: CPU-only JAX with a persistent compile cache.
 
-Real TPU hardware (single chip) is only used by bench.py; all tests —
-including the multi-chip sharding tests under tests/test_parallel*.py —
-run on CPU with 8 virtual XLA devices so CI needs no accelerator.
+The axon sitecustomize force-selects jax_platforms="axon,cpu" via
+jax.config.update at interpreter start, which silently overrides the
+JAX_PLATFORMS env var — so the env var alone is NOT enough; we must
+counter-update the config before any backend initializes.
+
+Multi-chip sharding is validated in a SEPARATE process
+(tests/test_parallel.py subprocesses __graft_entry__.dryrun_multichip
+with xla_force_host_platform_device_count): executables compiled under
+forced multi-device CPU topologies segfault XLA's persistent-cache
+serializer on this image (observed twice in put_executable_and_time), so
+the in-process suite stays single-device where cache writes are stable
+and warm across runs.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# Big-integer field arithmetic compiles slowly on XLA:CPU (~7 ms/HLO line);
+# cache compiled executables across test runs and sessions.
+_CACHE = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

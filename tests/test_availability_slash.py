@@ -1,0 +1,125 @@
+"""Availability accounting + double-sign slashing tests."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.consensus.signature import construct_commit_payload
+from harmony_tpu.numeric import Dec
+from harmony_tpu.staking import availability as AV
+from harmony_tpu.staking import slash as SL
+
+
+def test_block_signers_split():
+    keys = [bytes([i]) * 48 for i in range(10)]
+    bitmap = bytes([0b00000111, 0b00000010])  # signers 0,1,2,9
+    signed, missing = AV.block_signers(bitmap, keys)
+    assert signed == [keys[0], keys[1], keys[2], keys[9]]
+    assert len(missing) == 6
+    with pytest.raises(ValueError):
+        AV.block_signers(b"\x00", keys)
+
+
+def test_increment_and_threshold():
+    counters = {}
+    members = ["a", "b", "c"]
+    for _ in range(9):
+        AV.increment_counts(counters, ["a", "b"], members)
+    # a,b signed 9/9; c signed 0/9
+    assert counters["a"].num_blocks_signed == 9
+    assert counters["c"].num_blocks_to_sign == 9
+    snap = AV.Counters()
+    good = AV.compute_current_signing(snap, counters["a"])
+    assert not good.is_below_threshold
+    bad = AV.compute_current_signing(snap, counters["c"])
+    assert bad.is_below_threshold
+    # exactly 2/3 is BELOW threshold (LTE semantics, measure.go:178-181)
+    c = AV.Counters(num_blocks_to_sign=9, num_blocks_signed=6)
+    assert AV.compute_current_signing(snap, c).is_below_threshold
+    c = AV.Counters(num_blocks_to_sign=9, num_blocks_signed=7)
+    assert not AV.compute_current_signing(snap, c).is_below_threshold
+
+
+def test_detect_double_sign():
+    ballots = {b"key1": b"hashA"}
+    assert SL.detect_double_sign(ballots, b"key1", b"hashB") == b"hashA"
+    assert SL.detect_double_sign(ballots, b"key1", b"hashA") is None
+    assert SL.detect_double_sign(ballots, b"key2", b"hashB") is None
+
+
+@pytest.fixture(scope="module")
+def signed_evidence():
+    k = B.PrivateKey.generate(b"\x55")
+    moment = SL.Moment(epoch=3, shard_id=0, height=100, view_id=7)
+    h1, h2 = bytes([1]) * 32, bytes([2]) * 32
+    votes = []
+    for h in (h1, h2):
+        payload = construct_commit_payload(h, moment.height, moment.view_id)
+        votes.append(
+            SL.Vote(
+                signer_pubkeys=[k.pub.bytes],
+                block_header_hash=h,
+                signature=k.sign_hash(payload).bytes,
+            )
+        )
+    record = SL.Record(
+        evidence=SL.Evidence(
+            moment=moment,
+            first_vote=votes[0],
+            second_vote=votes[1],
+            offender=b"offender-addr",
+        ),
+        reporter=b"reporter-addr",
+    )
+    return record, k
+
+
+def test_verify_valid_record(signed_evidence):
+    record, k = signed_evidence
+    SL.verify_record(record, [k.pub.bytes])  # no raise
+
+
+def test_verify_rejects_bad_records(signed_evidence):
+    record, k = signed_evidence
+    committee = [k.pub.bytes]
+
+    same = SL.Record(
+        evidence=SL.Evidence(
+            moment=record.evidence.moment,
+            first_vote=record.evidence.first_vote,
+            second_vote=record.evidence.first_vote,  # no conflict
+            offender=record.evidence.offender,
+        ),
+        reporter=record.reporter,
+    )
+    with pytest.raises(SL.SlashVerifyError, match="conflict"):
+        SL.verify_record(same, committee)
+
+    self_report = SL.Record(
+        evidence=record.evidence, reporter=record.evidence.offender
+    )
+    with pytest.raises(SL.SlashVerifyError, match="same"):
+        SL.verify_record(self_report, committee)
+
+    other = B.PrivateKey.generate(b"\x66")
+    with pytest.raises(SL.SlashVerifyError, match="committee"):
+        SL.verify_record(record, [other.pub.bytes])
+
+    # tampered signature
+    import dataclasses
+
+    bad_vote = dataclasses.replace(
+        record.evidence.second_vote,
+        signature=record.evidence.first_vote.signature,
+    )
+    bad = SL.Record(
+        evidence=dataclasses.replace(record.evidence, second_vote=bad_vote),
+        reporter=record.reporter,
+    )
+    with pytest.raises(SL.SlashVerifyError, match="signature"):
+        SL.verify_record(bad, committee)
+
+
+def test_apply_slash_economics():
+    app = SL.apply_slash(stake=1000)
+    assert app.total_slashed == 20  # 2%
+    assert app.total_beneficiary_reward == 10  # half to reporter

@@ -1,0 +1,58 @@
+"""Wrapper-API tests: PublicKey/PrivateKey/Signature + multibls."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu import multibls as MB
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [B.PrivateKey.generate(bytes([i])) for i in range(3)]
+
+
+MSG = b"0123456789abcdef0123456789abcdef"
+
+
+def test_wrapper_roundtrip(keys):
+    k = keys[0]
+    assert len(k.pub.bytes) == B.PUBKEY_BYTES
+    assert B.PublicKey.from_bytes(k.pub.bytes) == k.pub
+    assert B.PrivateKey.from_bytes(k.bytes).pub == k.pub
+    sig = k.sign_hash(MSG)
+    assert len(sig.bytes) == B.SIG_BYTES
+    assert B.Signature.from_bytes(sig.bytes) == sig
+
+
+def test_sign_verify_wrapper(keys):
+    sig = keys[0].sign_hash(MSG)
+    assert sig.verify(keys[0].pub, MSG)
+    assert not sig.verify(keys[1].pub, MSG)
+
+
+def test_pubkey_add_sub(keys):
+    a, b = keys[0].pub, keys[1].pub
+    assert a.add(b).sub(b) == a
+
+
+def test_aggregate_and_verify(keys):
+    sigs = [k.sign_hash(MSG) for k in keys]
+    agg = B.aggregate_sigs(sigs)
+    agg_pk = keys[0].pub.add(keys[1].pub).add(keys[2].pub)
+    assert agg.verify(agg_pk, MSG)
+
+
+def test_multibls_dedup_and_aggregate(keys):
+    pks = MB.PrivateKeys.from_keys(keys + [keys[0]])  # duplicate dropped
+    assert len(pks) == 3
+    assert pks.public_keys().contains(keys[1].pub)
+    agg = pks.sign_hash_aggregated(MSG)
+    agg_pk = keys[0].pub.add(keys[1].pub).add(keys[2].pub)
+    assert agg.verify(agg_pk, MSG)
+
+
+def test_cached_deserialization(keys):
+    data = keys[0].pub.bytes
+    p1 = B.pubkey_from_bytes_cached(data)
+    p2 = B.pubkey_from_bytes_cached(data)
+    assert p1.point is p2.point  # LRU hit returns the same object

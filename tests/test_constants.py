@@ -1,0 +1,15 @@
+"""The committed _constants.py must match a fresh regeneration exactly —
+the kernels can never drift from the bigint reference derivation."""
+
+import pathlib
+
+from harmony_tpu.ref import constants_gen
+
+
+def test_generated_constants_up_to_date():
+    target = (
+        pathlib.Path(constants_gen.__file__).parent.parent
+        / "ops"
+        / "_constants.py"
+    )
+    assert target.read_text() == constants_gen.generate()

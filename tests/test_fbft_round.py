@@ -1,0 +1,112 @@
+"""Full in-process FBFT prepare+commit round over the framework's crypto
+path — the executable model of the reference's hot loop (SURVEY.md §3.2)
+and the small-scale version of BASELINE config #3."""
+
+import pytest
+
+from harmony_tpu.consensus import fbft as FB
+from harmony_tpu.consensus import quorum as Q
+from harmony_tpu.consensus.messages import MsgType, decode_sig_and_bitmap
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.ref.keccak import keccak256
+
+
+@pytest.fixture(scope="module")
+def network():
+    """7 nodes, one multi-key (2 slots): 8 committee slots total."""
+    keysets = [
+        PrivateKeys.from_keys(
+            [
+                __import__("harmony_tpu.bls", fromlist=["PrivateKey"])
+                .PrivateKey.generate(bytes([10 * n + j]))
+                for j in range(2 if n == 0 else 1)
+            ]
+        )
+        for n in range(7)
+    ]
+    committee = [k.pub.bytes for ks in keysets for k in ks]
+    cfg = FB.RoundConfig(committee=committee, block_num=42, view_id=3)
+
+    def decider():
+        return Q.Decider(Q.Policy.UNIFORM, committee)
+
+    leader = FB.Leader(keysets[0], cfg, decider())
+    validators = [FB.Validator(ks, cfg, decider()) for ks in keysets[1:]]
+    return leader, validators, cfg
+
+
+def test_full_round(network):
+    leader, validators, cfg = network
+    block = b"block body bytes"
+    block_hash = keccak256(block)
+
+    announce = leader.announce(block_hash, block)
+    assert announce.msg_type == MsgType.ANNOUNCE
+
+    # validators sign prepare votes; leader verifies each (hot loop)
+    prepares = [v.on_announce(announce) for v in validators]
+    # leader self-votes with its own keys
+    self_prep = FB.Validator(leader.keys, cfg, leader.decider).on_announce(
+        announce
+    )
+    assert leader.on_prepare(self_prep)
+    for p in prepares:
+        assert leader.on_prepare(p)
+
+    # duplicate vote rejected
+    assert not leader.on_prepare(prepares[0])
+
+    prepared = leader.try_prepared(block_hash)
+    assert prepared is not None and prepared.msg_type == MsgType.PREPARED
+    sig, bitmap = decode_sig_and_bitmap(prepared.payload, 1)
+    assert len(sig) == 96 and len(bitmap) == 1
+    assert bitmap == b"\xff"  # all 8 slots voted
+
+    # validators verify the prepare proof and emit commit votes
+    commits = [v.on_prepared(prepared) for v in validators]
+    assert all(c is not None for c in commits)
+    self_commit = FB.Validator(leader.keys, cfg, leader.decider).on_prepared(
+        prepared
+    )
+    assert leader.on_commit(self_commit)
+    for c in commits:
+        assert leader.on_commit(c)
+
+    committed = leader.try_committed(block_hash)
+    assert committed is not None and committed.msg_type == MsgType.COMMITTED
+
+    # every validator accepts the committed proof
+    for v in validators:
+        assert v.on_committed(committed)
+
+
+def test_tampered_proof_rejected(network):
+    leader, validators, cfg = network
+    block_hash = keccak256(b"other block")
+    # reuse the committed proof for a different block hash: must fail
+    committed = leader.try_committed(keccak256(b"block body bytes"))
+    tampered = FB.FBFTMessage(
+        msg_type=MsgType.COMMITTED,
+        view_id=cfg.view_id,
+        block_num=cfg.block_num,
+        block_hash=block_hash,
+        sender_pubkeys=committed.sender_pubkeys,
+        payload=committed.payload,
+    )
+    assert not validators[0].on_committed(tampered)
+
+
+def test_insufficient_quorum_no_prepared(network):
+    _, validators, cfg = network
+    # a fresh leader with only 2 of 8 votes must not produce PREPARED
+    from harmony_tpu.consensus.quorum import Decider, Policy
+
+    leader2 = FB.Leader(
+        validators[0].keys, cfg, Decider(Policy.UNIFORM, cfg.committee)
+    )
+    block = b"b2"
+    h = keccak256(block)
+    leader2.announce(h, block)
+    vote = validators[1].on_announce(leader2.announce(h, block))
+    assert leader2.on_prepare(vote)
+    assert leader2.try_prepared(h) is None

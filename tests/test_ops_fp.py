@@ -1,0 +1,61 @@
+"""Limb-arithmetic tests: JAX Fp ops vs Python bigints."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.ops import fp
+from harmony_tpu.ops.limbs import ints_to_limbs, limbs_to_int
+from harmony_tpu.ref.params import P
+
+rng = random.Random(0xF9)
+R = 1 << 384
+
+XS = [rng.randrange(P) for _ in range(16)]
+YS = [rng.randrange(P) for _ in range(16)]
+A = jnp.asarray(ints_to_limbs(XS))
+B = jnp.asarray(ints_to_limbs(YS))
+
+
+def _ints(arr):
+    return [limbs_to_int(np.array(row)) for row in np.asarray(arr)]
+
+
+def test_add_sub_neg():
+    assert _ints(fp.add(A, B)) == [(x + y) % P for x, y in zip(XS, YS)]
+    assert _ints(fp.sub(A, B)) == [(x - y) % P for x, y in zip(XS, YS)]
+    assert _ints(fp.neg(A)) == [(-x) % P for x in XS]
+
+
+def test_mont_mul_matches_bigint():
+    am = jnp.asarray(ints_to_limbs([x * R % P for x in XS]))
+    bm = jnp.asarray(ints_to_limbs([y * R % P for y in YS]))
+    got = _ints(fp.mont_mul(am, bm))
+    assert got == [x * y * R % P for x, y in zip(XS, YS)]
+
+
+def test_mont_domain_roundtrip():
+    assert _ints(fp.from_mont(fp.to_mont(A))) == XS
+
+
+def test_inverse():
+    am = jnp.asarray(ints_to_limbs([x * R % P for x in XS]))
+    prod = fp.mont_mul(fp.inv(am), am)
+    assert _ints(prod) == [R % P] * 16  # Montgomery form of 1
+
+
+def test_edge_values():
+    e = jnp.asarray(ints_to_limbs([0, 1, P - 1, P - 1]))
+    f2 = jnp.asarray(ints_to_limbs([0, P - 1, P - 1, 1]))
+    assert _ints(fp.add(e, f2)) == [0, 0, P - 2, 0]
+    assert _ints(fp.neg(e)) == [0, P - 1, 1, 1]
+    assert list(np.asarray(fp.is_zero(e))) == [True, False, False, False]
+
+
+def test_mul_worst_case_carries():
+    # p-1 squared exercises maximal limb magnitudes through the CIOS scan
+    worst = [P - 1, P - 1, 1, 0] * 4
+    wm = jnp.asarray(ints_to_limbs([x * R % P for x in worst]))
+    got = _ints(fp.mont_mul(wm, wm))
+    assert got == [x * x * R % P for x in worst]

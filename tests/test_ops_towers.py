@@ -1,0 +1,96 @@
+"""Tower-field tests: batched JAX Fp2/Fp6/Fp12 vs the bigint reference."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.ops import interop as I
+from harmony_tpu.ops import towers as T
+from harmony_tpu.ref import fields as F
+from harmony_tpu.ref.params import P
+
+rng = random.Random(0x70)
+
+
+def rfp2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def rfp6():
+    return (rfp2(), rfp2(), rfp2())
+
+
+def rfp12():
+    return (rfp6(), rfp6())
+
+
+A2_REF = [rfp2() for _ in range(4)]
+B2_REF = [rfp2() for _ in range(4)]
+A2 = jnp.asarray(I.batch(I.fp2_to_arr, A2_REF))
+B2 = jnp.asarray(I.batch(I.fp2_to_arr, B2_REF))
+
+A12_REF = [rfp12() for _ in range(2)]
+B12_REF = [rfp12() for _ in range(2)]
+A12 = jnp.asarray(I.batch(I.fp12_to_arr, A12_REF))
+B12 = jnp.asarray(I.batch(I.fp12_to_arr, B12_REF))
+
+
+def test_fp2_ops():
+    out = T.fp2_mul(A2, B2)
+    for i in range(4):
+        assert I.arr_to_fp2(np.array(out[i])) == F.fp2_mul(A2_REF[i], B2_REF[i])
+    out = T.fp2_sqr(A2)
+    for i in range(4):
+        assert I.arr_to_fp2(np.array(out[i])) == F.fp2_sqr(A2_REF[i])
+    out = T.fp2_inv(A2)
+    for i in range(4):
+        assert I.arr_to_fp2(np.array(out[i])) == F.fp2_inv(A2_REF[i])
+    out = T.fp2_mul_xi(A2)
+    for i in range(4):
+        assert I.arr_to_fp2(np.array(out[i])) == F.fp2_mul_xi(A2_REF[i])
+
+
+def test_fp6_ops():
+    a6 = [rfp6() for _ in range(2)]
+    b6 = [rfp6() for _ in range(2)]
+    a = jnp.asarray(I.batch(I.fp6_to_arr, a6))
+    b = jnp.asarray(I.batch(I.fp6_to_arr, b6))
+    out = T.fp6_mul(a, b)
+    for i in range(2):
+        assert I.arr_to_fp6(np.array(out[i])) == F.fp6_mul(a6[i], b6[i])
+    out = T.fp6_inv(a)
+    for i in range(2):
+        assert I.arr_to_fp6(np.array(out[i])) == F.fp6_inv(a6[i])
+    out = T.fp6_mul_v(a)
+    for i in range(2):
+        assert I.arr_to_fp6(np.array(out[i])) == F.fp6_mul_v(a6[i])
+
+
+def test_fp12_ops():
+    out = T.fp12_mul(A12, B12)
+    for i in range(2):
+        assert I.arr_to_fp12(np.array(out[i])) == F.fp12_mul(
+            A12_REF[i], B12_REF[i]
+        )
+    out = T.fp12_inv(A12)
+    for i in range(2):
+        assert I.arr_to_fp12(np.array(out[i])) == F.fp12_inv(A12_REF[i])
+    out = T.fp12_conj(A12)
+    for i in range(2):
+        assert I.arr_to_fp12(np.array(out[i])) == F.fp12_conj(A12_REF[i])
+
+
+def test_frobenius_against_generic_pow():
+    for k in (1, 2, 3):
+        out = T.fp12_frobenius(A12, k)
+        for i in range(2):
+            assert I.arr_to_fp12(np.array(out[i])) == F.fp12_pow(
+                A12_REF[i], P**k
+            ), f"frobenius^{k}"
+
+
+def test_fp12_pow_small():
+    out = T.fp12_pow(A12, [1, 0, 1, 1])
+    for i in range(2):
+        assert I.arr_to_fp12(np.array(out[i])) == F.fp12_pow(A12_REF[i], 11)

@@ -1,0 +1,35 @@
+"""Multi-chip sharding validation: run dryrun_multichip in a subprocess
+with 8 virtual CPU devices (see conftest.py for why not in-process).
+
+This compiles the full sharded quorum-check step (shard_map masked
+aggregation with an all_gather + data-parallel verify) from scratch each
+run, so it is the slowest test in the suite; skip with
+-k 'not multichip' when iterating elsewhere.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+
+def test_dryrun_multichip_8_devices():
+    root = pathlib.Path(__file__).parent.parent
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop the axon sitecustomize so jax_platforms isn't forced back
+    env["PYTHONPATH"] = str(root)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=3600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK" in proc.stdout

@@ -1,0 +1,165 @@
+"""Sidecar tests: wire protocol, live server with Python client, and the
+native C++ client library driven through ctypes."""
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import pytest
+
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.ref import bls as RB
+from harmony_tpu.sidecar import protocol as P
+from harmony_tpu.sidecar.client import SidecarClient
+from harmony_tpu.sidecar.server import SidecarServer
+
+MSG = b"0123456789abcdef0123456789abcdef"
+
+
+# --- protocol unit tests ---------------------------------------------------
+
+
+def test_frame_roundtrip():
+    f = P.pack_frame(P.MSG_PING, 7, b"abc")
+    msg_type, req_id, body = P.unpack_frame(f[4:])
+    assert (msg_type, req_id, body) == (P.MSG_PING, 7, b"abc")
+
+
+def test_body_roundtrips():
+    keys = [bytes([i]) * 48 for i in range(3)]
+    assert P.parse_set_committee(P.build_set_committee(5, 2, keys)) == (
+        5,
+        2,
+        keys,
+    )
+    body = P.build_agg_verify(1, 0, b"payload", b"\x07", bytes(96))
+    assert P.parse_agg_verify(body) == (1, 0, b"payload", b"\x07", bytes(96))
+    items = [(bytes(48), b"m1", bytes(96)), (bytes(48), b"m2", bytes(96))]
+    assert P.parse_verify_batch(P.build_verify_batch(items)) == items
+
+
+def test_frame_size_limit():
+    with pytest.raises(ValueError):
+        P.pack_frame(P.MSG_PING, 1, bytes(P.MAX_FRAME))
+
+
+# --- live server -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def committee():
+    sks = [RB.keygen(bytes([i])) for i in range(4)]
+    pks = [RB.pubkey(sk) for sk in sks]
+    sigs = [RB.sign(sk, MSG) for sk in sks]
+    return sks, pks, sigs
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SidecarServer().start()
+    yield s
+    s.stop()
+
+
+def test_ping_and_committee_upload(server, committee):
+    _, pks, _ = committee
+    c = SidecarClient(server.address)
+    assert c.ping() == P.VERSION
+    c.set_committee(3, 0, [RB.pubkey_to_bytes(p) for p in pks])
+    c.close()
+
+
+def test_agg_verify_over_socket(server, committee):
+    _, pks, sigs = committee
+    c = SidecarClient(server.address)
+    c.set_committee(4, 1, [RB.pubkey_to_bytes(p) for p in pks])
+    # 3-of-4 aggregate, bits 0, 2, 3
+    agg = RB.aggregate_sigs([sigs[0], sigs[2], sigs[3]])
+    mask = Mask(pks)
+    for i in (0, 2, 3):
+        mask.set_bit(i, True)
+    ok = c.agg_verify(4, 1, MSG, mask.mask_bytes(), RB.sig_to_bytes(agg))
+    assert ok
+    # wrong bitmap (all four) must fail
+    mask.set_bit(1, True)
+    assert not c.agg_verify(4, 1, MSG, mask.mask_bytes(), RB.sig_to_bytes(agg))
+    # unknown committee raises
+    with pytest.raises(KeyError):
+        c.agg_verify(99, 9, MSG, mask.mask_bytes(), RB.sig_to_bytes(agg))
+    c.close()
+
+
+def test_verify_batch_over_socket(server, committee):
+    _, pks, sigs = committee
+    c = SidecarClient(server.address)
+    items = [
+        (RB.pubkey_to_bytes(pks[i]), MSG, RB.sig_to_bytes(sigs[i]))
+        for i in range(3)
+    ]
+    # corrupt the last one: wrong signer
+    items.append(
+        (RB.pubkey_to_bytes(pks[3]), MSG, RB.sig_to_bytes(sigs[0]))
+    )
+    assert c.verify_batch(items) == [True, True, True, False]
+    c.close()
+
+
+# --- native C++ client -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    root = pathlib.Path(__file__).parent.parent
+    so = root / "native" / "libharmony_sidecar.so"
+    if not so.exists():
+        subprocess.run(
+            ["make", "-C", str(root / "native")], check=True,
+            capture_output=True,
+        )
+    lib = ctypes.CDLL(str(so))
+    lib.harmony_sidecar_connect_tcp.restype = ctypes.c_void_p
+    lib.harmony_sidecar_connect_tcp.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.harmony_sidecar_close.argtypes = [ctypes.c_void_p]
+    lib.harmony_sidecar_ping.argtypes = [ctypes.c_void_p]
+    lib.harmony_sidecar_set_committee.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.harmony_sidecar_agg_verify.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint16,
+        ctypes.c_char_p, ctypes.c_uint16,
+        ctypes.c_char_p,
+    ]
+    return lib
+
+
+def test_native_client_end_to_end(server, committee, native_lib):
+    _, pks, sigs = committee
+    host, port = server.address
+    h = native_lib.harmony_sidecar_connect_tcp(host.encode(), port)
+    assert h, "native connect failed"
+    try:
+        assert native_lib.harmony_sidecar_ping(h) == P.VERSION
+        keys = b"".join(RB.pubkey_to_bytes(p) for p in pks)
+        assert (
+            native_lib.harmony_sidecar_set_committee(h, 7, 0, keys, 4) == 0
+        )
+        agg = RB.aggregate_sigs(sigs)
+        mask = Mask(pks)
+        for i in range(4):
+            mask.set_bit(i, True)
+        bm = mask.mask_bytes()
+        ok = native_lib.harmony_sidecar_agg_verify(
+            h, 7, 0, MSG, len(MSG), bm, len(bm), RB.sig_to_bytes(agg)
+        )
+        assert ok == 1
+        # flipped bit -> invalid
+        bad = bytes([bm[0] ^ 0x02])
+        ok = native_lib.harmony_sidecar_agg_verify(
+            h, 7, 0, MSG, len(MSG), bad, len(bad), RB.sig_to_bytes(agg)
+        )
+        assert ok == 0
+    finally:
+        native_lib.harmony_sidecar_close(h)

@@ -1,0 +1,81 @@
+"""EPoS election + committee assignment tests."""
+
+from harmony_tpu.numeric import Dec, new_dec
+from harmony_tpu.shard import committee as SC
+from harmony_tpu.staking import effective as E
+
+
+def _orders():
+    return {
+        b"addr-a": E.SlotOrder(stake=1000, spread_among=[b"ka1", b"ka2"]),
+        b"addr-b": E.SlotOrder(stake=900, spread_among=[b"kb1"]),
+        b"addr-c": E.SlotOrder(stake=100, spread_among=[b"kc1"]),
+        b"addr-d": E.SlotOrder(stake=50, spread_among=[b"kd1"]),
+    }
+
+
+def test_spread_and_ordering():
+    med, picks = E.compute(_orders(), pull=10)
+    # a spreads 1000 over 2 keys = 500 each; order: kb1(900), ka(500,500),
+    # kc1(100), kd1(50)
+    assert [p.key for p in picks] == [b"kb1", b"ka1", b"ka2", b"kc1", b"kd1"]
+    assert picks[0].raw_stake.equal(new_dec(900))
+    assert picks[1].raw_stake.equal(new_dec(500))
+    assert med.equal(new_dec(500))  # odd count -> middle
+
+
+def test_median_even_count():
+    med, picks = E.compute(_orders(), pull=4)
+    # picks: 900, 500, 500, 100 -> median (500+500)/2
+    assert med.equal(new_dec(500))
+    assert len(picks) == 4
+
+
+def test_effective_stake_clamping():
+    med, picks = E.apply(_orders(), pull=10)
+    # median 500, c=0.15: bounds [425, 575]
+    by_key = {p.key: p for p in picks}
+    assert by_key[b"kb1"].epos_stake.equal(Dec.from_str("575"))  # capped
+    assert by_key[b"ka1"].epos_stake.equal(new_dec(500))  # untouched
+    assert by_key[b"kc1"].epos_stake.equal(Dec.from_str("425"))  # floored
+    assert by_key[b"kd1"].epos_stake.equal(Dec.from_str("425"))
+
+
+def test_extended_bound():
+    _, picks = E.apply(_orders(), pull=10, extended_bound=True)
+    by_key = {p.key: p for p in picks}
+    # c=0.35: bounds [325, 675]
+    assert by_key[b"kb1"].epos_stake.equal(Dec.from_str("675"))
+    assert by_key[b"kd1"].epos_stake.equal(Dec.from_str("325"))
+
+
+def test_pull_limits_winners():
+    _, picks = E.apply(_orders(), pull=2)
+    assert len(picks) == 2
+    assert {p.key for p in picks} == {b"kb1", b"ka1"} or {
+        p.key for p in picks
+    } == {b"kb1", b"ka2"}
+
+
+def test_committee_assignment_round_robin_and_shard_by_key():
+    hmy = [(f"h{i}".encode(), f"hk{i}".encode()) for i in range(4)]
+    state = SC.epos_staked_committee(
+        epoch=10,
+        shard_count=2,
+        harmony_accounts=hmy,
+        harmony_per_shard=2,
+        orders=_orders(),
+        external_slots_total=4,
+    )
+    assert len(state.shards) == 2
+    # round robin: shard0 gets h0, h2; shard1 gets h1, h3
+    assert [s.bls_pubkey for s in state.shards[0].slots[:2]] == [b"hk0", b"hk2"]
+    assert [s.bls_pubkey for s in state.shards[1].slots[:2]] == [b"hk1", b"hk3"]
+    # winners land on shard (key mod 2)
+    for c in state.shards:
+        for s in c.slots[2:]:
+            assert int.from_bytes(s.bls_pubkey, "big") % 2 == c.shard_id
+            assert s.effective_stake is not None
+    # all 4 winners present across shards
+    ext = [s for c in state.shards for s in c.slots if s.effective_stake]
+    assert len(ext) == 4

@@ -1,0 +1,103 @@
+"""View-change protocol tests: M1/M2/M3 collection, NEWVIEW, next leader."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.consensus import view_change as VC
+from harmony_tpu.consensus.messages import encode_sig_and_bitmap
+from harmony_tpu.consensus.quorum import Decider, Phase, Policy
+from harmony_tpu.multibls import PrivateKeys
+
+
+@pytest.fixture(scope="module")
+def committee():
+    keysets = [
+        PrivateKeys.from_keys([B.PrivateKey.generate(bytes([60 + i]))])
+        for i in range(4)
+    ]
+    keys = [ks[0].pub.bytes for ks in keysets]
+    return keysets, keys
+
+
+def test_next_leader_rotation(committee):
+    _, keys = committee
+    assert VC.next_leader_key(keys, keys[1], 1) == keys[2]
+    assert VC.next_leader_key(keys, keys[3], 1) == keys[0]  # wraps
+    assert VC.next_leader_key(keys, keys[0], 2) == keys[2]
+    # unknown last leader: gap from start
+    assert VC.next_leader_key(keys, b"nope", 1) == keys[0]
+
+
+def test_view_change_nil_quorum_and_new_view(committee):
+    keysets, keys = committee
+    view_id = 9
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    msgs = [
+        VC.construct_viewchange(ks, view_id, block_num=5) for ks in keysets
+    ]
+    for m in msgs:
+        assert coll.on_viewchange(m)
+    # duplicate rejected
+    assert not coll.on_viewchange(msgs[0])
+    # wrong view id rejected
+    assert not coll.on_viewchange(
+        VC.construct_viewchange(keysets[0], view_id + 1, 5)
+    )
+    nv = coll.try_new_view(block_num=5, leader_keys=keysets[0])
+    assert nv is not None
+    assert VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_view_change_with_prepared_block(committee):
+    keysets, keys = committee
+    view_id = 11
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    block_hash = bytes(range(32))
+    proof = encode_sig_and_bitmap(bytes(96), b"\x0f")
+    # two voters saw the prepared block, two did not
+    for ks in keysets[:2]:
+        assert coll.on_viewchange(
+            VC.construct_viewchange(ks, view_id, 6, block_hash, proof)
+        )
+    for ks in keysets[2:]:
+        assert coll.on_viewchange(VC.construct_viewchange(ks, view_id, 6))
+    nv = coll.try_new_view(block_num=6, leader_keys=keysets[1])
+    assert nv is not None
+    assert nv.m1_payload == VC.m1_payload(block_hash, proof)
+    assert VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_new_view_missing_m1_rejected(committee):
+    keysets, keys = committee
+    view_id = 13
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    block_hash = bytes(32)
+    proof = encode_sig_and_bitmap(bytes(96), b"\x0f")
+    for ks in keysets[:3]:
+        coll.on_viewchange(
+            VC.construct_viewchange(ks, view_id, 7, block_hash, proof)
+        )
+    coll.on_viewchange(VC.construct_viewchange(keysets[3], view_id, 7))
+    nv = coll.try_new_view(block_num=7, leader_keys=keysets[0])
+    assert nv is not None
+    nv.m1_payload = b""  # strip the prepared payload: must now fail
+    assert not VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_tampered_m3_rejected(committee):
+    keysets, keys = committee
+    view_id = 15
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    for ks in keysets:
+        coll.on_viewchange(VC.construct_viewchange(ks, view_id, 8))
+    nv = coll.try_new_view(block_num=8, leader_keys=keysets[0])
+    nv.view_id += 1  # signature no longer matches the claimed view
+    assert not VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
