@@ -116,6 +116,26 @@ def aggregate_sigs(sigs) -> Signature:
     return Signature(RB.aggregate_sigs([s.point for s in sigs]))
 
 
+def verify_aggregate_bytes(
+    pubkeys_bytes, payload: bytes, sig_bytes: bytes
+) -> bool:
+    """Verify a 96-byte signature against the SUM of serialized pubkeys —
+    the shape every multi-key vote check takes (consensus votes,
+    view-change votes, slash evidence).  Malformed input returns False,
+    never raises."""
+    if not pubkeys_bytes:
+        return False
+    try:
+        agg_pk = None
+        for pk_bytes in pubkeys_bytes:
+            pk = pubkey_from_bytes_cached(pk_bytes)
+            agg_pk = pk if agg_pk is None else agg_pk.add(pk)
+        sig = Signature.from_bytes(sig_bytes)
+    except (ValueError, KeyError):
+        return False
+    return RB.verify(agg_pk.point, payload, sig.point)
+
+
 @functools.lru_cache(maxsize=1024)
 def _cached_pubkey_from_bytes(data: bytes):
     return RB.pubkey_from_bytes(data)

@@ -1,0 +1,6 @@
+"""Configuration subsystem: epoch-gated chain features and sharding
+schedules (reference: internal/params/config.go + internal/configs/
+sharding/ — SURVEY.md §2.6)."""
+
+from .chain import ChainConfig  # noqa: F401
+from .sharding import Instance, Schedule  # noqa: F401

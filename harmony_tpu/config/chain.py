@@ -1,0 +1,48 @@
+"""Epoch-gated chain configuration.
+
+Behavioral parity with the reference's ChainConfig (reference:
+internal/params/config.go:690-780): every protocol upgrade is an epoch
+threshold; a feature is active in epoch e iff its threshold is set and
+<= e.  The reference carries ~60 such gates; this model implements the
+mechanism plus the gates the TPU pipeline consumes — more are data, not
+code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ChainConfig:
+    chain_id: int = 1
+    # epoch thresholds; None = never activates
+    staking_epoch: int | None = 0  # reference: IsStaking (config.go:724)
+    two_seconds_epoch: int | None = 0  # block time 2s (config.go:740)
+    leader_rotation_epoch: int | None = None
+    epos_bound_v2_epoch: int | None = None  # extended 0.35 EPoS bound
+    cross_shard_epoch: int | None = 0
+    extra: dict = field(default_factory=dict)  # name -> epoch threshold
+
+    @staticmethod
+    def _active(threshold: int | None, epoch: int) -> bool:
+        return threshold is not None and epoch >= threshold
+
+    def is_staking(self, epoch: int) -> bool:
+        return self._active(self.staking_epoch, epoch)
+
+    def is_two_seconds(self, epoch: int) -> bool:
+        return self._active(self.two_seconds_epoch, epoch)
+
+    def is_leader_rotation(self, epoch: int) -> bool:
+        return self._active(self.leader_rotation_epoch, epoch)
+
+    def is_epos_bound_v2(self, epoch: int) -> bool:
+        return self._active(self.epos_bound_v2_epoch, epoch)
+
+    def is_cross_shard(self, epoch: int) -> bool:
+        return self._active(self.cross_shard_epoch, epoch)
+
+    def is_active(self, name: str, epoch: int) -> bool:
+        """Generic gate lookup for features carried in ``extra``."""
+        return self._active(self.extra.get(name), epoch)

@@ -1,0 +1,73 @@
+"""Sharding schedules: per-epoch network topology.
+
+Behavioral parity with the reference's shardingconfig (reference:
+internal/configs/sharding/shardingconfig.go — Schedule/Instance;
+mainnet.go:70-140 epoch->instance switching, :364-389 instance data):
+an Instance fixes shard count, slots per shard, Harmony-operated slot
+count and the Harmony vote share; a Schedule maps an epoch to the
+Instance active at that epoch (thresholds ascending, last one wins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..numeric import Dec, one_dec
+
+
+@dataclass(frozen=True)
+class Instance:
+    num_shards: int
+    slots_per_shard: int
+    harmony_nodes_per_shard: int
+    harmony_vote_percent: Dec
+
+    def external_slots_per_shard(self) -> int:
+        return self.slots_per_shard - self.harmony_nodes_per_shard
+
+    def external_vote_percent(self) -> Dec:
+        return one_dec().sub(self.harmony_vote_percent)
+
+    def total_slots(self) -> int:
+        return self.num_shards * self.slots_per_shard
+
+
+class Schedule:
+    """Epoch -> Instance lookup over ascending thresholds."""
+
+    def __init__(self, instances: list):
+        """instances: [(first_epoch, Instance)] with ascending epochs."""
+        if not instances:
+            raise ValueError("empty schedule")
+        epochs = [e for e, _ in instances]
+        if epochs != sorted(epochs) or epochs[0] != 0:
+            raise ValueError("schedule must start at epoch 0, ascending")
+        self._instances = list(instances)
+
+    def instance_for_epoch(self, epoch: int) -> Instance:
+        chosen = self._instances[0][1]
+        for first, inst in self._instances:
+            if epoch >= first:
+                chosen = inst
+            else:
+                break
+        return chosen
+
+
+# A mainnet-shaped schedule (the reference's V3->V5 trajectory:
+# 4 shards x 250 slots shrinking to 2 x 200 with the Harmony vote share
+# stepping 0.49 -> 0.01 — reference: internal/configs/sharding/
+# mainnet.go:364-389).  Epoch thresholds here are representative; real
+# deployments supply their own table.
+MAINNET_LIKE = Schedule(
+    [
+        (0, Instance(4, 250, 170, Dec.from_str("0.68"))),
+        (100, Instance(4, 250, 130, Dec.from_str("0.49"))),
+        (1000, Instance(2, 200, 50, Dec.from_str("0.06"))),
+        (1500, Instance(2, 200, 50, Dec.from_str("0.01"))),
+    ]
+)
+
+LOCALNET = Schedule(
+    [(0, Instance(2, 10, 5, Dec.from_str("0.68")))]
+)

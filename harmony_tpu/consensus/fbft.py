@@ -90,8 +90,9 @@ class Leader(_Node):
         """Shared hot loop: verify the vote sig (possibly multi-key
         aggregated by the sender) against the sum of its sender keys
         (reference: consensus/leader.go:156-197).  Votes for a different
-        block hash, from non-committee keys, or malformed are dropped —
-        never raised — matching the reference's tolerant message loop."""
+        block hash, from non-committee keys, overlapping an already-voted
+        key, or malformed are dropped — never raised — matching the
+        reference's tolerant message loop."""
         if (
             self.current_block_hash is None
             or msg.block_hash != self.current_block_hash
@@ -101,18 +102,16 @@ class Leader(_Node):
         committee = set(self.cfg.committee)
         if any(pk not in committee for pk in msg.sender_pubkeys):
             return False
-        sender = tuple(msg.sender_pubkeys)
-        if sender in store:
-            return False  # duplicate vote message
-        try:
-            sig = B.Signature.from_bytes(msg.payload)
-        except ValueError:
+        # per-KEY dedup: a key-set overlapping any prior vote would put a
+        # key's signature into the aggregate twice while the bitmap marks
+        # it once, breaking the quorum proof
+        if any(
+            self.decider.has_voted(phase, pk) for pk in msg.sender_pubkeys
+        ):
             return False
-        agg_pk = None
-        for pk_bytes in msg.sender_pubkeys:
-            pk = B.pubkey_from_bytes_cached(pk_bytes)
-            agg_pk = pk if agg_pk is None else agg_pk.add(pk)
-        if not RB.verify(agg_pk.point, payload, sig.point):
+        if not B.verify_aggregate_bytes(
+            msg.sender_pubkeys, payload, msg.payload
+        ):
             return False
         for pk_bytes in msg.sender_pubkeys:
             self.decider.submit_vote(
@@ -120,7 +119,7 @@ class Leader(_Node):
                 Ballot(pk_bytes, msg.block_hash, msg.payload,
                        msg.block_num, msg.view_id),
             )
-        store[sender] = sig
+        store[tuple(msg.sender_pubkeys)] = B.Signature.from_bytes(msg.payload)
         return True
 
     def on_prepare(self, msg: FBFTMessage) -> bool:
@@ -195,16 +194,22 @@ class Validator(_Node):
     def _verify_proof(self, msg: FBFTMessage, payload: bytes) -> bool:
         """Decode [sig || bitmap], check quorum-by-mask, verify the
         aggregate signature — the reference's validator-side check
-        (validator.go:217-236; engine.go:619-642 uses the same shape)."""
-        mask = Mask(self.committee_points)
-        sig_bytes, bitmap = decode_sig_and_bitmap(
-            msg.payload, mask.bytes_len()
-        )
-        mask.set_mask(bitmap)
-        if not self.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
+        (validator.go:217-236; engine.go:619-642 uses the same shape).
+        Malformed payloads return False, never raise."""
+        try:
+            mask = Mask(self.committee_points)
+            sig_bytes, bitmap = decode_sig_and_bitmap(
+                msg.payload, mask.bytes_len()
+            )
+            mask.set_mask(bitmap)
+            if not self.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
+                return False
+            agg_pk = mask.aggregate_public(device=False)
+            if agg_pk is None:
+                return False
+            sig = B.Signature.from_bytes(sig_bytes)
+        except ValueError:
             return False
-        agg_pk = mask.aggregate_public(device=False)
-        sig = B.Signature.from_bytes(sig_bytes)
         return RB.verify(agg_pk, payload, sig.point)
 
     def on_prepared(self, msg: FBFTMessage):

@@ -21,6 +21,13 @@ from ..ref import bls as RB
 from ..ref import curve as RC
 
 
+def bits_from_bytes(bitmap: bytes, n: int):
+    """Unpack a little-endian participation bitmap to a 0/1 list — THE
+    bit-order convention of the whole protocol (bit i = bit i&7 of byte
+    i>>3; reference: crypto/bls/mask.go:112-120)."""
+    return [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]
+
+
 class Mask:
     """Committee bitmap with device-backed aggregation.
 

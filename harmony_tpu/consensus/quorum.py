@@ -85,6 +85,9 @@ class Decider:
     def count(self, phase: Phase) -> int:
         return len(self._ballots[phase])
 
+    def has_voted(self, phase: Phase, key) -> bool:
+        return key in self._ballots[phase]
+
     def ballots(self, phase: Phase):
         return list(self._ballots[phase].values())
 

@@ -31,7 +31,7 @@ from dataclasses import dataclass, field
 from .. import bls as B
 from ..multibls import PrivateKeys
 from ..ref import bls as RB
-from .mask import Mask
+from .mask import Mask, bits_from_bytes
 from .quorum import Ballot, Decider, Phase
 
 NIL = b"\x01"  # reference: consensus/config.go:52
@@ -126,43 +126,51 @@ class ViewChangeCollector:
         self.committee_points = [
             B.PublicKey.from_bytes(k).point for k in committee
         ]
+        # the prepared-block claim, authenticated per-voter by their m1
+        # signature on arrival; its quorum proof is the embedded PREPARED
+        # aggregate itself (self-certifying), so no m1 sig store is kept
         self.m1_payload: bytes = b""
-        self.m1_sigs: dict = {}
         self.m2_sigs: dict = {}
         self.m3_sigs: dict = {}
 
-    def _verify_sender_sig(self, msg, payload: bytes, sig_bytes: bytes):
-        agg_pk = None
-        for pk_bytes in msg.sender_pubkeys:
-            pk = B.pubkey_from_bytes_cached(pk_bytes)
-            agg_pk = pk if agg_pk is None else agg_pk.add(pk)
-        sig = B.Signature.from_bytes(sig_bytes)
-        return RB.verify(agg_pk.point, payload, sig.point)
-
     def on_viewchange(self, msg: ViewChangeMsg) -> bool:
-        if msg.view_id != self.view_id:
+        """Validate fully, THEN mutate — a rejected message must leave no
+        trace in the signature stores.  Non-committee keys and key-sets
+        overlapping an earlier vote are dropped."""
+        if msg.view_id != self.view_id or not msg.sender_pubkeys:
             return False
-        sender = tuple(msg.sender_pubkeys)
-        if sender in self.m3_sigs:
-            return False  # duplicate (errDupM3 analog)
-        if not self._verify_sender_sig(
-            msg, view_id_payload(self.view_id), msg.m3_sig
+        committee = set(self.committee)
+        if any(pk not in committee for pk in msg.sender_pubkeys):
+            return False
+        if any(
+            self.decider.has_voted(Phase.VIEWCHANGE, pk)
+            for pk in msg.sender_pubkeys
+        ):
+            return False  # duplicate / overlapping (errDupM3 analog)
+        if not B.verify_aggregate_bytes(
+            msg.sender_pubkeys, view_id_payload(self.view_id), msg.m3_sig
         ):
             return False
         if msg.m1_sig:
-            if not self._verify_sender_sig(msg, msg.m1_payload, msg.m1_sig):
+            if not B.verify_aggregate_bytes(
+                msg.sender_pubkeys, msg.m1_payload, msg.m1_sig
+            ):
                 return False
-            if not self.m1_payload:
-                self.m1_payload = msg.m1_payload
-            elif self.m1_payload != msg.m1_payload:
+            if self.m1_payload and self.m1_payload != msg.m1_payload:
                 return False  # conflicting prepared blocks
-            self.m1_sigs[sender] = msg.m1_sig
         elif msg.m2_sig:
-            if not self._verify_sender_sig(msg, NIL, msg.m2_sig):
+            if not B.verify_aggregate_bytes(
+                msg.sender_pubkeys, NIL, msg.m2_sig
+            ):
                 return False
-            self.m2_sigs[sender] = msg.m2_sig
         else:
             return False
+        # all checks passed: commit
+        sender = tuple(msg.sender_pubkeys)
+        if msg.m1_sig:
+            self.m1_payload = self.m1_payload or msg.m1_payload
+        else:
+            self.m2_sigs[sender] = msg.m2_sig
         self.m3_sigs[sender] = msg.m3_sig
         for pk in msg.sender_pubkeys:
             self.decider.submit_vote(
@@ -202,7 +210,11 @@ def verify_new_view(
     msg: NewViewMsg, committee: list, decider: Decider
 ) -> bool:
     """Validator-side NEWVIEW verification (reference:
-    view_change_construct.go:154-210 VerifyNewViewMsg)."""
+    view_change_construct.go:154-210 VerifyNewViewMsg): M3 aggregate +
+    quorum, optional M2 aggregate vs NIL, the M3>M2 consistency rule,
+    and — when a prepared block is carried — the embedded PREPARED
+    quorum proof itself (aggregate prepare signature over the block hash
+    checked against its own bitmap and quorum)."""
     points = [B.PublicKey.from_bytes(k).point for k in committee]
 
     def check_agg(sig_bytes, bitmap, payload) -> tuple:
@@ -226,7 +238,7 @@ def verify_new_view(
     if not ok3:
         return False
     if not decider.is_quorum_achieved_by_mask(
-        _bits_from_bytes(msg.m3_bitmap, len(committee))
+        bits_from_bytes(msg.m3_bitmap, len(committee))
     ):
         return False
 
@@ -239,8 +251,21 @@ def verify_new_view(
     # prepared block — its payload must be present
     if m3_count > m2_count and not msg.m1_payload:
         return False
+    if msg.m1_payload:
+        # the carried PREPARED proof must itself verify: a fabricated
+        # "prepared block" would otherwise re-lock validators on a block
+        # that never had prepare quorum
+        if len(msg.m1_payload) < 32 + 96:
+            return False
+        block_hash = msg.m1_payload[:32]
+        proof = msg.m1_payload[32:]
+        sig_bytes = proof[:96]
+        bitmap = proof[96:]
+        ok1, _ = check_agg(sig_bytes, bitmap, block_hash)
+        if not ok1:
+            return False
+        if not decider.is_quorum_achieved_by_mask(
+            bits_from_bytes(bitmap, len(committee))
+        ):
+            return False
     return True
-
-
-def _bits_from_bytes(bitmap: bytes, n: int):
-    return [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]

@@ -61,6 +61,7 @@ def compute_roster(
     voters: dict = {}
     ordered = []
     last_staked: Voter | None = None
+    last_any: Voter | None = None
     hmy_count_dec = new_dec(hmy_count) if hmy_count else None
 
     for s in slots:
@@ -90,13 +91,20 @@ def compute_roster(
         if s.bls_pubkey not in voters:
             voters[s.bls_pubkey] = v
         ordered.append(s.bls_pubkey)
+        last_any = v
 
     # force the sum to exactly one: residue goes to the last staked voter
+    # (matching the reference), or to the last voter of any kind for an
+    # all-Harmony committee — the invariant must hold unconditionally
+    residue_taker = last_staked if last_staked is not None else last_any
     diff = one_dec().sub(ours.add(theirs))
-    if not diff.is_zero() and last_staked is not None:
-        last_staked.overall_percent = last_staked.overall_percent.add(diff)
-        theirs = theirs.add(diff)
-    if last_staked is not None and not ours.add(theirs).equal(one_dec()):
+    if not diff.is_zero() and residue_taker is not None:
+        residue_taker.overall_percent = residue_taker.overall_percent.add(diff)
+        if residue_taker.is_harmony:
+            ours = ours.add(diff)
+        else:
+            theirs = theirs.add(diff)
+    if slots and not ours.add(theirs).equal(one_dec()):
         raise ValueError("voting power does not sum to one")
 
     return Roster(

@@ -1,0 +1,3 @@
+"""Node-runtime pieces: wire envelope and pubsub ingress validation
+(reference: api/proto/common.go + node/harmony/node.go:473-608 —
+SURVEY.md §2.6)."""

@@ -1,0 +1,97 @@
+"""Gossip ingress: message envelope + cheap pre-verification filtering.
+
+Behavioral parity with the reference:
+
+- the network envelope is [category byte][type byte][payload]
+  (reference: api/proto/common.go — category 0x00 consensus, 0x01 node);
+- before ANY signature work, consensus messages pass cheap checks:
+  shard id match, viewID freshness window (msg.viewID + 5 >= current),
+  role filtering (leader drops leader-bound-only types it sent, etc.),
+  sender key in committee, bitmap length sanity (reference:
+  node/harmony/node.go:473-608 validateShardBoundMessage).  The point is
+  DoS economy: pairing work only happens for messages that could matter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from ..consensus.messages import FBFTMessage, MsgType
+
+VIEW_ID_WINDOW = 5  # reference: node.go:545-555 (viewID + 5 < current -> drop)
+
+
+class MessageCategory(IntEnum):
+    CONSENSUS = 0x00
+    NODE = 0x01
+
+
+def pack_envelope(category: MessageCategory, msg_type: int, payload: bytes) -> bytes:
+    return bytes([category, msg_type]) + payload
+
+
+def parse_envelope(data: bytes):
+    if len(data) < 2:
+        raise ValueError("message shorter than envelope")
+    return MessageCategory(data[0]), data[1], data[2:]
+
+
+@dataclass
+class IngressContext:
+    """Snapshot of consensus state the filter needs."""
+
+    shard_id: int
+    current_view_id: int
+    committee_keys: set
+    is_leader: bool = False
+    in_view_change: bool = False
+    committee_size: int = 0
+
+    def __post_init__(self):
+        if not self.committee_size:
+            self.committee_size = len(self.committee_keys)
+
+
+@dataclass
+class IngressResult:
+    accepted: bool
+    reason: str = ""
+
+
+_LEADER_BOUND = {MsgType.PREPARE, MsgType.COMMIT}
+_VALIDATOR_BOUND = {MsgType.ANNOUNCE, MsgType.PREPARED, MsgType.COMMITTED}
+_VIEWCHANGE_TYPES = {MsgType.VIEWCHANGE, MsgType.NEWVIEW}
+
+
+def validate_consensus_message(
+    msg: FBFTMessage, ctx: IngressContext, shard_id: int
+) -> IngressResult:
+    """The cheap pre-checks; returns (accepted, reason).  No crypto."""
+    if shard_id != ctx.shard_id:
+        return IngressResult(False, "wrong shard")
+    if msg.msg_type in _VIEWCHANGE_TYPES:
+        if not ctx.in_view_change:
+            return IngressResult(False, "not in view change")
+    else:
+        if msg.view_id + VIEW_ID_WINDOW < ctx.current_view_id:
+            return IngressResult(False, "view id too old")
+    # role filtering (node.go:577-608): leader consumes votes, validators
+    # consume proposals/proofs
+    if msg.msg_type in _LEADER_BOUND and not ctx.is_leader:
+        return IngressResult(False, "leader-bound message at validator")
+    if msg.msg_type in _VALIDATOR_BOUND and ctx.is_leader:
+        return IngressResult(False, "validator-bound message at leader")
+    if not msg.sender_pubkeys:
+        return IngressResult(False, "no sender key")
+    for key in msg.sender_pubkeys:
+        if len(key) != 48:
+            return IngressResult(False, "bad sender key size")
+        if key not in ctx.committee_keys:
+            return IngressResult(False, "sender not in committee")
+    # bitmap length sanity for aggregate proofs
+    if msg.msg_type in (MsgType.PREPARED, MsgType.COMMITTED):
+        expected = (ctx.committee_size + 7) >> 3
+        if len(msg.payload) != 96 + expected:
+            return IngressResult(False, "bad aggregate payload length")
+    return IngressResult(True)

@@ -15,7 +15,6 @@ from jax.sharding import PartitionSpec as P
 from ..ops import bls as OB
 from ..ops import curve as CV
 from ..ops import pairing as OP
-from ..ops import towers as T
 
 BATCH_AXIS = "batch"
 
@@ -87,28 +86,9 @@ def sharded_pairing_product(mesh: Mesh):
     )
     def fn(p_chunk, q_chunk):
         fs = OP.miller_loop(p_chunk, q_chunk)
-        local = fs
-        while local.shape[0] > 1:
-            k = local.shape[0]
-            half = k // 2
-            merged = T.fp12_mul(local[:half], local[half : 2 * half])
-            local = (
-                jnp.concatenate([merged, local[2 * half :]], axis=0)
-                if k % 2
-                else merged
-            )
-        partials = jax.lax.all_gather(local[0], BATCH_AXIS)  # (d, fp12)
-        total = partials
-        while total.shape[0] > 1:
-            k = total.shape[0]
-            half = k // 2
-            merged = T.fp12_mul(total[:half], total[half : 2 * half])
-            total = (
-                jnp.concatenate([merged, total[2 * half :]], axis=0)
-                if k % 2
-                else merged
-            )
-        return OP.final_exponentiation(total[0])
+        local = OP.fp12_tree_reduce(fs)
+        partials = jax.lax.all_gather(local, BATCH_AXIS)  # (d, fp12)
+        return OP.final_exponentiation(OP.fp12_tree_reduce(partials))
 
     return fn
 

@@ -66,14 +66,21 @@ def miller_loop(p_pt, q_pt):
 
 
 def final_exponentiation(f):
-    """f^((p^12 - 1) / r).
+    """f^(3 (p^12 - 1) / r) — the framework's canonical pairing power.
 
     Easy part: f^(p^6 - 1) = conj(f)/f, then ^(p^2 + 1) by generic pow.
-    Hard part: generic pow by (p^4 - p^2 + 1)/r.
+    Hard part: generic pow by 3 (p^4 - p^2 + 1)/r.
+
+    The CUBE of the textbook reduced pairing is used throughout (both
+    here and the TPU path): the TPU hard part runs the x-addition chain
+    3 lambda = (x-1)^2 (x+p)(x^2+p^2-1) + 3 (identity checked in
+    tests), and since gcd(3, r) = 1 the cubed pairing is an equally
+    valid bilinear non-degenerate pairing — standard practice for BLS12
+    final-exponentiation chains.
     """
     f1 = F.fp12_mul(F.fp12_conj(f), F.fp12_inv(f))  # ^(p^6 - 1)
     f2 = F.fp12_mul(F.fp12_pow(f1, P * P), f1)  # ^(p^2 + 1)
-    hard = (P**4 - P**2 + 1) // R_ORDER
+    hard = 3 * ((P**4 - P**2 + 1) // R_ORDER)
     return F.fp12_pow(f2, hard)
 
 

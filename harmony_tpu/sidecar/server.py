@@ -135,7 +135,9 @@ class SidecarServer:
         n = len(table)
         if len(bitmap) != (n + 7) >> 3:
             return P.STATUS_BAD_REQUEST, b""
-        bits = [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]
+        from ..consensus.mask import bits_from_bytes
+
+        bits = bits_from_bytes(bitmap, n)
         with self._exec_lock:
             ok = self._agg_verify_device(table, bits, payload, sig)
         return P.STATUS_OK, bytes([1 if ok else 0])

@@ -25,12 +25,11 @@ def block_signers(bitmap: bytes, committee_keys: list):
     (little-endian bit order, matching the consensus Mask)."""
     if len(bitmap) != (len(committee_keys) + 7) >> 3:
         raise ValueError("bitmap length mismatch")
-    signed, missing = [], []
-    for i, key in enumerate(committee_keys):
-        if (bitmap[i >> 3] >> (i & 7)) & 1:
-            signed.append(key)
-        else:
-            missing.append(key)
+    from ..consensus.mask import bits_from_bytes
+
+    bits = bits_from_bytes(bitmap, len(committee_keys))
+    signed = [k for k, b in zip(committee_keys, bits) if b]
+    missing = [k for k, b in zip(committee_keys, bits) if not b]
     return signed, missing
 
 

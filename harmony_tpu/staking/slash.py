@@ -16,7 +16,6 @@ from dataclasses import dataclass, field
 
 from .. import bls as B
 from ..consensus.signature import construct_commit_payload
-from ..ref import bls as RB
 
 
 @dataclass
@@ -100,12 +99,9 @@ def verify_record(
             vote.block_header_hash, ev.moment.height, ev.moment.view_id,
             is_staking,
         )
-        agg_pk = None
-        for pk_bytes in vote.signer_pubkeys:
-            pk = B.pubkey_from_bytes_cached(pk_bytes)
-            agg_pk = pk if agg_pk is None else agg_pk.add(pk)
-        sig = B.Signature.from_bytes(vote.signature)
-        if not RB.verify(agg_pk.point, payload, sig.point):
+        if not B.verify_aggregate_bytes(
+            vote.signer_pubkeys, payload, vote.signature
+        ):
             raise SlashVerifyError("ballot signature invalid")
 
 

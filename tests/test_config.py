@@ -1,0 +1,44 @@
+"""Chain-config gates and sharding-schedule tests."""
+
+import pytest
+
+from harmony_tpu.config import ChainConfig, Instance, Schedule
+from harmony_tpu.config.sharding import LOCALNET, MAINNET_LIKE
+from harmony_tpu.numeric import Dec
+
+
+def test_epoch_gates():
+    cfg = ChainConfig(
+        staking_epoch=10, two_seconds_epoch=None, extra={"hip30": 50}
+    )
+    assert not cfg.is_staking(9)
+    assert cfg.is_staking(10)
+    assert cfg.is_staking(11)
+    assert not cfg.is_two_seconds(10**9)  # None never activates
+    assert not cfg.is_active("hip30", 49)
+    assert cfg.is_active("hip30", 50)
+    assert not cfg.is_active("unknown", 50)
+
+
+def test_schedule_lookup():
+    s = MAINNET_LIKE
+    assert s.instance_for_epoch(0).num_shards == 4
+    assert s.instance_for_epoch(99).harmony_nodes_per_shard == 170
+    assert s.instance_for_epoch(100).harmony_nodes_per_shard == 130
+    assert s.instance_for_epoch(1200).num_shards == 2
+    v5 = s.instance_for_epoch(10**6)
+    assert v5.harmony_vote_percent.equal(Dec.from_str("0.01"))
+    assert v5.external_vote_percent().equal(Dec.from_str("0.99"))
+    assert v5.external_slots_per_shard() == 150
+    assert v5.total_slots() == 400
+
+
+def test_schedule_validation():
+    inst = LOCALNET.instance_for_epoch(0)
+    assert inst.num_shards == 2
+    with pytest.raises(ValueError):
+        Schedule([])
+    with pytest.raises(ValueError):
+        Schedule([(5, inst)])  # must start at 0
+    with pytest.raises(ValueError):
+        Schedule([(0, inst), (10, inst), (5, inst)])  # not ascending

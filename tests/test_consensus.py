@@ -137,6 +137,18 @@ def test_roster_sums_to_one():
     assert v.overall_percent.sub(Dec.from_str("0.357")).raw in (0, 1, -1)
 
 
+def test_roster_all_harmony_sums_to_one():
+    # no external stakers: the residue lands on the last Harmony voter
+    # and the invariant must still hold exactly
+    slots = [VP.Slot(f"h{i}", bytes([i]), None) for i in range(3)]
+    r = VP.compute_roster(slots, one_dec(), zero_dec())
+    assert r.our_voting_power.add(r.their_voting_power).equal(one_dec())
+    # the last slot absorbed the 1e-18 residue
+    assert r.voters[bytes([2])].overall_percent.gt(
+        r.voters[bytes([0])].overall_percent
+    )
+
+
 def test_roster_residue_to_last_staker():
     # 3 stakers with equal stake: 1/3 each cannot sum exactly; the residue
     # lands on the last one

@@ -96,6 +96,43 @@ def test_tampered_proof_rejected(network):
     assert not validators[0].on_committed(tampered)
 
 
+def test_overlapping_keyset_vote_rejected(network):
+    """A key-set overlapping an earlier vote would double a signature in
+    the aggregate while the bitmap marks it once — must be dropped."""
+    _, validators, cfg = network
+    from harmony_tpu.consensus.quorum import Decider, Policy
+    from harmony_tpu.multibls import PrivateKeys
+
+    leader = FB.Leader(
+        validators[0].keys, cfg, Decider(Policy.UNIFORM, cfg.committee)
+    )
+    block = b"overlap test block"
+    h = keccak256(block)
+    announce = leader.announce(h, block)
+    v1 = validators[1]
+    assert leader.on_prepare(v1.on_announce(announce))
+    # combined key-set containing v1's already-voted key
+    combined = PrivateKeys.from_keys(list(v1.keys) + list(validators[2].keys))
+    overlap_vote = FB.Validator(combined, cfg, leader.decider).on_announce(
+        announce
+    )
+    assert not leader.on_prepare(overlap_vote)
+
+
+def test_malformed_proof_rejected_not_raised(network):
+    _, validators, cfg = network
+    for bad_payload in (b"short", bytes(96), bytes(96) + b"\x00\x01"):
+        msg = FB.FBFTMessage(
+            msg_type=MsgType.PREPARED,
+            view_id=cfg.view_id,
+            block_num=cfg.block_num,
+            block_hash=keccak256(b"x"),
+            sender_pubkeys=[cfg.committee[0]],
+            payload=bad_payload,
+        )
+        assert validators[0].on_prepared(msg) is None  # no exception
+
+
 def test_insufficient_quorum_no_prepared(network):
     _, validators, cfg = network
     # a fresh leader with only 2 of 8 votes must not produce PREPARED

@@ -1,0 +1,93 @@
+"""Ingress envelope + pre-validation filter tests."""
+
+import pytest
+
+from harmony_tpu.consensus.messages import FBFTMessage, MsgType
+from harmony_tpu.node.ingress import (
+    IngressContext,
+    MessageCategory,
+    pack_envelope,
+    parse_envelope,
+    validate_consensus_message,
+)
+
+KEYS = [bytes([i + 1]) * 48 for i in range(8)]
+
+
+def _ctx(**kw):
+    base = dict(
+        shard_id=2,
+        current_view_id=100,
+        committee_keys=set(KEYS),
+        is_leader=True,
+    )
+    base.update(kw)
+    return IngressContext(**base)
+
+
+def _msg(**kw):
+    base = dict(
+        msg_type=MsgType.PREPARE,
+        view_id=100,
+        block_num=7,
+        block_hash=bytes(32),
+        sender_pubkeys=[KEYS[0]],
+        payload=bytes(96),
+    )
+    base.update(kw)
+    return FBFTMessage(**base)
+
+
+def test_envelope_roundtrip():
+    env = pack_envelope(MessageCategory.CONSENSUS, 3, b"payload")
+    assert parse_envelope(env) == (MessageCategory.CONSENSUS, 3, b"payload")
+    with pytest.raises(ValueError):
+        parse_envelope(b"\x00")
+
+
+def test_shard_and_view_window():
+    assert validate_consensus_message(_msg(), _ctx(), shard_id=2).accepted
+    assert not validate_consensus_message(_msg(), _ctx(), shard_id=3).accepted
+    # viewID + 5 < current -> drop; boundary passes
+    old = _msg(view_id=94)
+    assert not validate_consensus_message(old, _ctx(), 2).accepted
+    edge = _msg(view_id=95)
+    assert validate_consensus_message(edge, _ctx(), 2).accepted
+
+
+def test_role_filtering():
+    vote = _msg()  # PREPARE is leader-bound
+    assert not validate_consensus_message(
+        vote, _ctx(is_leader=False), 2
+    ).accepted
+    proof = _msg(
+        msg_type=MsgType.PREPARED, payload=bytes(96 + 1)
+    )  # 8 keys -> 1 bitmap byte
+    assert not validate_consensus_message(proof, _ctx(is_leader=True), 2).accepted
+    assert validate_consensus_message(
+        proof, _ctx(is_leader=False), 2
+    ).accepted
+
+
+def test_sender_and_bitmap_checks():
+    stranger = _msg(sender_pubkeys=[bytes(48)])
+    assert not validate_consensus_message(stranger, _ctx(), 2).accepted
+    short_key = _msg(sender_pubkeys=[b"short"])
+    assert not validate_consensus_message(short_key, _ctx(), 2).accepted
+    empty = _msg(sender_pubkeys=[])
+    assert not validate_consensus_message(empty, _ctx(), 2).accepted
+    bad_bitmap = _msg(
+        msg_type=MsgType.PREPARED,
+        payload=bytes(96 + 2),  # expected 1 byte for 8 keys
+    )
+    assert not validate_consensus_message(
+        bad_bitmap, _ctx(is_leader=False), 2
+    ).accepted
+
+
+def test_viewchange_gating():
+    vc = _msg(msg_type=MsgType.VIEWCHANGE, view_id=101)
+    assert not validate_consensus_message(vc, _ctx(), 2).accepted
+    assert validate_consensus_message(
+        vc, _ctx(in_view_change=True, is_leader=False), 2
+    ).accepted

@@ -29,6 +29,15 @@ def test_pairing_nondegenerate_order_r(e_gen):
     assert F.fp12_pow(e_gen, R_ORDER) == F.FP12_ONE
 
 
+def test_hard_part_x_chain_identity():
+    # the TPU final exponentiation runs this addition chain; the cubed
+    # pairing convention rests on this identity (see ref/pairing.py)
+    from harmony_tpu.ref.params import P, X
+
+    lam = (P**4 - P**2 + 1) // R_ORDER
+    assert (X - 1) ** 2 * (X + P) * (X**2 + P**2 - 1) + 3 == 3 * lam
+
+
 def test_bilinearity(e_gen):
     a = rng.randrange(1, 1 << 64)
     b = rng.randrange(1, 1 << 64)

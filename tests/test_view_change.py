@@ -50,6 +50,18 @@ def test_view_change_nil_quorum_and_new_view(committee):
     assert VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
 
 
+def _real_prepared_proof(keysets, keys, block_hash):
+    """A genuine PREPARED quorum proof: every committee member's prepare
+    signature aggregated, full bitmap."""
+    sigs = [ks.sign_hash_aggregated(block_hash) for ks in keysets]
+    agg = B.aggregate_sigs(sigs)
+    n = len(keys)
+    bitmap = bytearray((n + 7) >> 3)
+    for i in range(n):
+        bitmap[i >> 3] |= 1 << (i & 7)
+    return encode_sig_and_bitmap(agg.bytes, bytes(bitmap))
+
+
 def test_view_change_with_prepared_block(committee):
     keysets, keys = committee
     view_id = 11
@@ -57,7 +69,7 @@ def test_view_change_with_prepared_block(committee):
         keys, Decider(Policy.UNIFORM, keys), view_id
     )
     block_hash = bytes(range(32))
-    proof = encode_sig_and_bitmap(bytes(96), b"\x0f")
+    proof = _real_prepared_proof(keysets, keys, block_hash)
     # two voters saw the prepared block, two did not
     for ks in keysets[:2]:
         assert coll.on_viewchange(
@@ -77,8 +89,8 @@ def test_new_view_missing_m1_rejected(committee):
     coll = VC.ViewChangeCollector(
         keys, Decider(Policy.UNIFORM, keys), view_id
     )
-    block_hash = bytes(32)
-    proof = encode_sig_and_bitmap(bytes(96), b"\x0f")
+    block_hash = bytes(range(32))
+    proof = _real_prepared_proof(keysets, keys, block_hash)
     for ks in keysets[:3]:
         coll.on_viewchange(
             VC.construct_viewchange(ks, view_id, 7, block_hash, proof)
@@ -88,6 +100,44 @@ def test_new_view_missing_m1_rejected(committee):
     assert nv is not None
     nv.m1_payload = b""  # strip the prepared payload: must now fail
     assert not VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_fabricated_m1_proof_rejected(committee):
+    """A NEWVIEW carrying a made-up prepared block (garbage aggregate)
+    must be rejected — the embedded PREPARED proof is verified."""
+    keysets, keys = committee
+    view_id = 17
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    for ks in keysets:
+        coll.on_viewchange(VC.construct_viewchange(ks, view_id, 9))
+    nv = coll.try_new_view(block_num=9, leader_keys=keysets[0])
+    # malicious leader grafts a fabricated prepared payload
+    fake_hash = bytes(range(32))
+    fake_proof = encode_sig_and_bitmap(
+        keysets[0].sign_hash_aggregated(b"x" * 32).bytes, b"\x0f"
+    )
+    nv.m1_payload = VC.m1_payload(fake_hash, fake_proof)
+    assert not VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_outsider_and_overlapping_votes_rejected(committee):
+    keysets, keys = committee
+    view_id = 19
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    outsider = PrivateKeys.from_keys([B.PrivateKey.generate(b"\x77")])
+    # non-committee voter: rejected, no crash, no store pollution
+    assert not coll.on_viewchange(
+        VC.construct_viewchange(outsider, view_id, 9)
+    )
+    assert not coll.m3_sigs and not coll.m2_sigs
+    # overlapping key-set: second vote containing an already-voted key
+    assert coll.on_viewchange(VC.construct_viewchange(keysets[0], view_id, 9))
+    both = PrivateKeys.from_keys(list(keysets[0]) + list(keysets[1]))
+    assert not coll.on_viewchange(VC.construct_viewchange(both, view_id, 9))
 
 
 def test_tampered_m3_rejected(committee):

@@ -1,0 +1,34 @@
+"""BLS-VRF tests."""
+
+import pytest
+
+from harmony_tpu import crypto_vrf as VRF
+from harmony_tpu.bls import PrivateKey
+
+
+def test_evaluate_verify_roundtrip():
+    sk = PrivateKey.generate(b"\x42")
+    msg = b"epoch randomness seed...........x"
+    out, proof = VRF.evaluate(sk, msg)
+    assert len(out) == VRF.VRF_OUTPUT_BYTES and len(proof) == 96
+    assert VRF.verify(sk.pub, msg, proof) == out
+    # deterministic
+    out2, proof2 = VRF.evaluate(sk, msg)
+    assert (out2, proof2) == (out, proof)
+
+
+def test_verify_rejects_wrong_inputs():
+    sk = PrivateKey.generate(b"\x42")
+    other = PrivateKey.generate(b"\x43")
+    msg = b"epoch randomness seed...........x"
+    _, proof = VRF.evaluate(sk, msg)
+    with pytest.raises(ValueError):
+        VRF.verify(other.pub, msg, proof)
+    with pytest.raises(ValueError):
+        VRF.verify(sk.pub, b"different message...............", proof)
+    with pytest.raises(ValueError):
+        VRF.proof_to_hash(b"short")
+    # distinct keys -> distinct outputs for the same message
+    out_a, _ = VRF.evaluate(sk, msg)
+    out_b, _ = VRF.evaluate(other, msg)
+    assert out_a != out_b
