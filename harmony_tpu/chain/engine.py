@@ -1,0 +1,212 @@
+"""The consensus engine's signature-verification surface.
+
+Behavioral parity with the reference's engine (reference:
+internal/chain/engine.go:576-683 + internal/chain/sig.go:13-50):
+
+- ``decode_sig_bitmap``: split + deserialize an aggregate commit proof
+  against an epoch committee (DecodeSigBitmap);
+- ``verify_header_signature``: epoch-context cache -> quorum-by-mask ->
+  ONE aggregate pairing check, with a verified-signature LRU keyed on
+  (hash, sig, bitmap) so replayed checks are free (engine.go:606-617;
+  the reference caps the cache key at 64-byte bitmaps = 512 validators,
+  engine.go:660-662 — this implementation has no such cap);
+- ``verify_headers_batch``: the block-replay throughput path (reference
+  call stack SURVEY.md §3.3): each header's commit payload is rebuilt,
+  all masked committee aggregations and ALL pairing checks for the batch
+  run as one device program — the reference does these one block at a
+  time through cgo.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..consensus.mask import Mask, bits_from_bytes
+from ..consensus.quorum import Decider, Policy
+from ..consensus.signature import construct_commit_payload
+from ..ref import bls as RB
+from .header import Header
+
+
+class EpochContext:
+    """Per-(shard, epoch) committee context: deserialized keys, quorum
+    decider, device table (reference: engine.go:644-663 getEpochCtxCached)."""
+
+    def __init__(self, committee_keys: list, policy: Policy = Policy.UNIFORM,
+                 roster=None):
+        self.serialized = list(committee_keys)
+        self.points = [RB.pubkey_from_bytes(k) for k in committee_keys]
+        self.decider = Decider(policy, committee_keys, roster)
+        self._device_aff = None
+
+    def device_table(self):
+        import jax.numpy as jnp
+
+        from ..ops import interop as I
+
+        if self._device_aff is None:
+            self._device_aff = jnp.asarray(I.g1_batch_affine(self.points))
+        return self._device_aff
+
+    def __len__(self):
+        return len(self.serialized)
+
+
+class _LRU(OrderedDict):
+    def __init__(self, cap: int):
+        super().__init__()
+        self.cap = cap
+
+    def put(self, key):
+        self[key] = True
+        self.move_to_end(key)
+        while len(self) > self.cap:
+            self.popitem(last=False)
+
+
+# Device batches are padded up to one of these pinned sizes (chunked
+# above the largest) so EVERY verify reuses one of three compiled
+# programs — no shape-polymorphic recompiles on the hot path
+# (SURVEY.md §7.3: "pinned batch shapes with bucketing").
+VERIFY_BUCKETS = (8, 64, 256)
+
+
+def bucket_size(n: int) -> int:
+    for b in VERIFY_BUCKETS:
+        if n <= b:
+            return b
+    return VERIFY_BUCKETS[-1]
+
+
+class Engine:
+    """Header signature verification with epoch-ctx + verified-sig caches."""
+
+    def __init__(self, committee_provider, sig_cache_size: int = 4096):
+        """committee_provider(shard_id, epoch) -> EpochContext."""
+        self._provider = committee_provider
+        self._epoch_ctx: dict = {}
+        self._verified = _LRU(sig_cache_size)
+
+    def epoch_context(self, shard_id: int, epoch: int) -> EpochContext:
+        key = (shard_id, epoch)
+        ctx = self._epoch_ctx.get(key)
+        if ctx is None:
+            ctx = self._provider(shard_id, epoch)
+            self._epoch_ctx[key] = ctx
+        return ctx
+
+    def decode_sig_bitmap(self, ctx: EpochContext, sig_bytes: bytes,
+                          bitmap: bytes):
+        """(signature point, Mask) or ValueError (sig.go:37-50)."""
+        sig = RB.sig_from_bytes(sig_bytes)
+        if sig is None:
+            raise ValueError("aggregate signature is infinity")
+        mask = Mask(ctx.points)
+        mask.set_mask(bitmap)
+        return sig, mask
+
+    def _commit_payload(self, header: Header, is_staking: bool) -> bytes:
+        return construct_commit_payload(
+            header.hash(), header.block_num, header.view_id, is_staking
+        )
+
+    def verify_header_signature(
+        self, header: Header, sig_bytes: bytes, bitmap: bytes,
+        is_staking: bool = True,
+    ) -> bool:
+        """One header's aggregate commit check (engine.go:576-642)."""
+        cache_key = (header.hash(), sig_bytes, bitmap)
+        if cache_key in self._verified:
+            return True
+        ctx = self.epoch_context(header.shard_id, header.epoch)
+        try:
+            sig, mask = self.decode_sig_bitmap(ctx, sig_bytes, bitmap)
+        except ValueError:
+            return False
+        if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
+            return False
+        agg_pk = mask.aggregate_public(device=False)
+        if agg_pk is None:
+            return False
+        payload = self._commit_payload(header, is_staking)
+        if not RB.verify(agg_pk, payload, sig):
+            return False
+        self._verified.put(cache_key)
+        return True
+
+    def verify_seal(self, header: Header, child: Header,
+                    is_staking: bool = True) -> bool:
+        """Verify header via the commit proof its CHILD carries
+        (engine.go:237-262 VerifySeal)."""
+        return self.verify_header_signature(
+            header, child.last_commit_sig, child.last_commit_bitmap,
+            is_staking,
+        )
+
+    # --- the batched replay path ------------------------------------------
+
+    def verify_headers_batch(
+        self, items: list, is_staking=True
+    ) -> list:
+        """items: [(header, sig_bytes, bitmap)].  All masked committee
+        aggregations and pairing checks run as ONE device program — the
+        throughput path for chain replay (BASELINE config #5).
+
+        Committees may differ per header (cross-epoch batches are fine);
+        quorum checks and payload construction stay host-side exactly as
+        the deterministic reference logic demands.  ``is_staking`` is a
+        bool for the whole batch or a per-item list (a batch spanning
+        the staking-epoch boundary changes the commit payload shape).
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import bls as OB
+        from ..ops import interop as I
+        from ..ref.hash_to_curve import hash_to_g2
+
+        flags = (
+            list(is_staking)
+            if isinstance(is_staking, (list, tuple))
+            else [is_staking] * len(items)
+        )
+        if len(flags) != len(items):
+            raise ValueError("is_staking list length != items length")
+        results = [False] * len(items)
+        survivors = []  # (index, pk_point, h_point, sig_point)
+        for idx, (header, sig_bytes, bitmap) in enumerate(items):
+            cache_key = (header.hash(), sig_bytes, bitmap)
+            if cache_key in self._verified:
+                results[idx] = True
+                continue
+            ctx = self.epoch_context(header.shard_id, header.epoch)
+            try:
+                sig, mask = self.decode_sig_bitmap(ctx, sig_bytes, bitmap)
+            except ValueError:
+                continue
+            if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
+                continue
+            agg_pk = mask.aggregate_public(device=False)
+            if agg_pk is None:
+                continue
+            payload = self._commit_payload(header, flags[idx])
+            h_pt = hash_to_g2(payload)
+            survivors.append((idx, agg_pk, h_pt, sig))
+        for chunk_start in range(0, len(survivors), VERIFY_BUCKETS[-1]):
+            chunk = survivors[chunk_start:chunk_start + VERIFY_BUCKETS[-1]]
+            n, padded = len(chunk), bucket_size(len(chunk))
+            # pad with copies of the first element: results are sliced
+            # back to n, so pad lanes are never consulted
+            sel = list(range(n)) + [0] * (padded - n)
+            pk = np.asarray(I.g1_batch_affine([chunk[i][1] for i in sel]))
+            hh = np.asarray(I.g2_batch_affine([chunk[i][2] for i in sel]))
+            sg = np.asarray(I.g2_batch_affine([chunk[i][3] for i in sel]))
+            ok = np.asarray(
+                OB.verify(jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg))
+            )[:n]
+            for (idx, _, _, _), good in zip(chunk, ok):
+                if bool(good):
+                    results[idx] = True
+                    header, sig_bytes, bitmap = items[idx]
+                    self._verified.put((header.hash(), sig_bytes, bitmap))
+        return results

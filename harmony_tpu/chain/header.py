@@ -1,0 +1,55 @@
+"""Block headers: the signable chain objects.
+
+Behavioral parity with the reference's header model (reference:
+block/header.go:25-173 — versioned headers behind one facade; the fields
+here are the consensus-relevant subset): every header carries its
+parent's aggregate commit signature + bitmap (``last_commit_sig``), so
+verifying header N's seal checks the committee's signature carried in
+header N+1 (reference: internal/chain/engine.go:237-262 VerifySeal,
+api/service/stagedstreamsync/sig_verify.go:37-48).
+
+Hashing is keccak-256 over a canonical field serialization (the
+reference hashes the RLP encoding; this framework uses a fixed-width
+layout — a documented, deterministic choice)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..ref.keccak import keccak256
+
+
+@dataclass
+class Header:
+    shard_id: int
+    block_num: int
+    epoch: int
+    view_id: int
+    parent_hash: bytes = bytes(32)
+    root: bytes = bytes(32)  # state root
+    tx_root: bytes = bytes(32)  # body commitment (ordered tx hashes)
+    timestamp: int = 0
+    # parent's quorum proof: [96B agg sig || bitmap]
+    last_commit_sig: bytes = b""
+    last_commit_bitmap: bytes = b""
+    extra: bytes = b""
+
+    def signing_fields(self) -> bytes:
+        """Canonical fixed-layout serialization of the sealed fields.
+
+        The commit sig/bitmap are deliberately EXCLUDED — they arrive in
+        the NEXT block and must not affect this header's hash (same
+        separation as the reference's sealed-vs-commit fields)."""
+        out = bytearray()
+        for v in (self.shard_id, self.block_num, self.epoch, self.view_id,
+                  self.timestamp):
+            out += v.to_bytes(8, "little")
+        for b in (self.parent_hash, self.root, self.tx_root):
+            if len(b) != 32:
+                raise ValueError("hash fields must be 32 bytes")
+            out += b
+        out += len(self.extra).to_bytes(4, "little") + self.extra
+        return bytes(out)
+
+    def hash(self) -> bytes:
+        return keccak256(self.signing_fields())

@@ -1,0 +1,108 @@
+"""Header model + engine verification tests, including a mini chain
+replay through the batched device path (BASELINE config #5 in miniature)."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.chain.engine import Engine, EpochContext
+from harmony_tpu.chain.header import Header
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.consensus.signature import construct_commit_payload
+from harmony_tpu.multibls import PrivateKeys
+
+N_KEYS = 4
+
+
+@pytest.fixture(scope="module")
+def committee():
+    keys = [B.PrivateKey.generate(bytes([30 + i])) for i in range(N_KEYS)]
+    serialized = [k.pub.bytes for k in keys]
+    return keys, serialized
+
+
+def _provider(serialized):
+    def provide(shard_id, epoch):
+        return EpochContext(serialized)
+
+    return provide
+
+
+def _sign_header(header, keys, signer_idx):
+    payload = construct_commit_payload(
+        header.hash(), header.block_num, header.view_id, True
+    )
+    sigs = [keys[i].sign_hash(payload) for i in signer_idx]
+    agg = B.aggregate_sigs(sigs)
+    mask = Mask([k.pub.point for k in keys])
+    for i in signer_idx:
+        mask.set_bit(i, True)
+    return agg.bytes, mask.mask_bytes()
+
+
+def test_header_hash_excludes_commit_proof():
+    h = Header(shard_id=0, block_num=5, epoch=1, view_id=5)
+    base = h.hash()
+    h.last_commit_sig = b"x" * 96
+    h.last_commit_bitmap = b"\x0f"
+    assert h.hash() == base  # commit proof must not change the hash
+    h2 = Header(shard_id=0, block_num=6, epoch=1, view_id=5)
+    assert h2.hash() != base
+
+
+def test_verify_header_signature_and_cache(committee):
+    keys, serialized = committee
+    eng = Engine(_provider(serialized))
+    h = Header(shard_id=0, block_num=10, epoch=2, view_id=10)
+    sig, bitmap = _sign_header(h, keys, [0, 1, 2, 3])
+    assert eng.verify_header_signature(h, sig, bitmap)
+    # cached second call (host-only fast path)
+    assert eng.verify_header_signature(h, sig, bitmap)
+    # insufficient quorum: only 2 of 4 (threshold 2*4//3+1 = 3)
+    sig2, bitmap2 = _sign_header(h, keys, [0, 1])
+    assert not eng.verify_header_signature(h, sig2, bitmap2)
+    # signature/bitmap mismatch
+    sig3, _ = _sign_header(h, keys, [0, 1, 2])
+    assert not eng.verify_header_signature(h, sig3, bitmap)
+
+
+def test_verify_seal_via_child(committee):
+    keys, serialized = committee
+    eng = Engine(_provider(serialized))
+    parent = Header(shard_id=0, block_num=20, epoch=2, view_id=20)
+    sig, bitmap = _sign_header(parent, keys, [0, 1, 2])
+    child = Header(
+        shard_id=0,
+        block_num=21,
+        epoch=2,
+        view_id=21,
+        parent_hash=parent.hash(),
+        last_commit_sig=sig,
+        last_commit_bitmap=bitmap,
+    )
+    assert eng.verify_seal(parent, child)
+    assert not eng.verify_seal(child, child)  # proof is for the parent
+
+
+def test_batched_replay(committee):
+    keys, serialized = committee
+    eng = Engine(_provider(serialized))
+    headers = []
+    prev_hash = bytes(32)
+    for n in range(5):
+        h = Header(
+            shard_id=0, block_num=100 + n, epoch=3, view_id=100 + n,
+            parent_hash=prev_hash,
+        )
+        sig, bitmap = _sign_header(h, keys, [0, 1, 2, 3])
+        headers.append((h, sig, bitmap))
+        prev_hash = h.hash()
+    # corrupt one: replace block 102's sig with block 101's
+    items = list(headers)
+    items[2] = (items[2][0], items[1][1], items[2][2])
+    results = eng.verify_headers_batch(items)
+    assert results == [True, True, False, True, True]
+    # second replay: everything good is cache-hit (no device work needed)
+    results2 = eng.verify_headers_batch(
+        [headers[0], headers[1], headers[3], headers[4]]
+    )
+    assert results2 == [True] * 4

@@ -1,0 +1,81 @@
+"""Cross-link verification tests: multiple shards' proofs on the beacon."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.chain.crosslink import (
+    CrossLink,
+    verify_crosslink,
+    verify_crosslinks_batch,
+)
+from harmony_tpu.chain.engine import Engine, EpochContext
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.consensus.signature import construct_commit_payload
+
+
+@pytest.fixture(scope="module")
+def shards():
+    """Two shards with distinct 4-key committees."""
+    committees = {}
+    for shard in (0, 1):
+        keys = [
+            B.PrivateKey.generate(bytes([50 + 10 * shard + i]))
+            for i in range(4)
+        ]
+        committees[shard] = keys
+    return committees
+
+
+def _make_link(committees, shard, block_num, signers):
+    keys = committees[shard]
+    block_hash = bytes([shard]) * 16 + block_num.to_bytes(16, "little")
+    payload = construct_commit_payload(block_hash, block_num, block_num, True)
+    agg = B.aggregate_sigs([keys[i].sign_hash(payload) for i in signers])
+    mask = Mask([k.pub.point for k in keys])
+    for i in signers:
+        mask.set_bit(i, True)
+    return CrossLink(
+        shard_id=shard,
+        block_num=block_num,
+        view_id=block_num,
+        epoch=1,
+        block_hash=block_hash,
+        signature=agg.bytes,
+        bitmap=mask.mask_bytes(),
+    )
+
+
+@pytest.fixture(scope="module")
+def engine(shards):
+    def provider(shard_id, epoch):
+        return EpochContext([k.pub.bytes for k in shards[shard_id]])
+
+    return Engine(provider)
+
+
+def test_single_crosslink(engine, shards):
+    link = _make_link(shards, 0, 500, [0, 1, 2, 3])
+    assert verify_crosslink(engine, link)
+    # quorum failure: 2 of 4
+    weak = _make_link(shards, 0, 501, [0, 1])
+    assert not verify_crosslink(engine, weak)
+
+
+def test_batch_across_shards(engine, shards):
+    links = [
+        _make_link(shards, 0, 600, [0, 1, 2]),
+        _make_link(shards, 1, 600, [1, 2, 3]),
+        _make_link(shards, 0, 601, [0, 1, 2, 3]),
+    ]
+    # tamper: shard-1 proof presented as shard-0's (wrong committee)
+    stolen = CrossLink(
+        shard_id=0,
+        block_num=600,
+        view_id=600,
+        epoch=1,
+        block_hash=links[1].block_hash,
+        signature=links[1].signature,
+        bitmap=links[1].bitmap,
+    )
+    results = verify_crosslinks_batch(engine, links + [stolen])
+    assert results == [True, True, True, False]

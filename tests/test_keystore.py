@@ -1,0 +1,59 @@
+"""Encrypted keyfile tests."""
+
+import json
+
+import pytest
+
+from harmony_tpu import keystore as KS
+from harmony_tpu.bls import PrivateKey
+
+
+def test_roundtrip(tmp_path):
+    sk = PrivateKey.generate(b"\x11")
+    path = tmp_path / "validator.key"
+    KS.save_key(str(path), sk, "hunter2")
+    loaded = KS.load_key(str(path), "hunter2")
+    assert loaded.scalar == sk.scalar
+    assert loaded.pub == sk.pub
+
+
+def test_wrong_passphrase_rejected():
+    sk = PrivateKey.generate(b"\x12")
+    blob = KS.encrypt_key(sk, "correct")
+    with pytest.raises(ValueError, match="wrong passphrase"):
+        KS.decrypt_key(blob, "incorrect")
+
+
+def test_tamper_detection():
+    sk = PrivateKey.generate(b"\x13")
+    blob = json.loads(KS.encrypt_key(sk, "pw"))
+    ct = bytearray(bytes.fromhex(blob["ciphertext"]))
+    ct[0] ^= 1
+    blob["ciphertext"] = bytes(ct).hex()
+    with pytest.raises(ValueError, match="wrong passphrase or corrupted"):
+        KS.decrypt_key(json.dumps(blob).encode(), "pw")
+
+
+def test_malformed_file():
+    with pytest.raises(ValueError, match="malformed"):
+        KS.decrypt_key(b"not json", "pw")
+    with pytest.raises(ValueError, match="malformed"):
+        KS.decrypt_key(b"{}", "pw")
+
+
+def test_distinct_salts():
+    sk = PrivateKey.generate(b"\x14")
+    b1, b2 = KS.encrypt_key(sk, "pw"), KS.encrypt_key(sk, "pw")
+    assert json.loads(b1)["salt"] != json.loads(b2)["salt"]
+    assert json.loads(b1)["ciphertext"] != json.loads(b2)["ciphertext"]
+
+
+def test_load_keys_multi(tmp_path):
+    sks = [PrivateKey.generate(bytes([i])) for i in range(3)]
+    pairs = []
+    for i, sk in enumerate(sks):
+        p = tmp_path / f"k{i}.key"
+        KS.save_key(str(p), sk, f"pw{i}")
+        pairs.append((str(p), f"pw{i}"))
+    loaded = KS.load_keys(pairs)
+    assert [k.pub for k in loaded] == [k.pub for k in sks]
