@@ -1,0 +1,206 @@
+"""The Blockchain: canonical chain + state + commit-sig storage.
+
+The role of the reference's core.BlockChain (reference:
+core/blockchain.go:47-360 interface, core/blockchain_impl.go:1666
+InsertChain, WriteBlockWithState, ReadCommitSig/WriteCommitSig —
+SURVEY.md §2.4): insert verified blocks, execute them against state,
+persist everything through the rawdb schema, and expose the read
+surface consensus and RPC consume.
+
+Verification on insert mirrors the reference's sync path (SURVEY.md
+§3.3): each block's commit proof arrives either in the NEXT header
+(``last_commit_sig``) or as the explicitly passed proof for the tip;
+signature checks route through the chain Engine (one aggregate pairing
+per block, batched across an insert).
+"""
+
+from __future__ import annotations
+
+from ..chain.header import Header
+from .genesis import Genesis
+from .state import StateDB
+from .state_processor import StateProcessor
+from .types import Block
+from . import rawdb
+
+
+class ChainError(ValueError):
+    pass
+
+
+class Blockchain:
+    def __init__(self, db, genesis: Genesis, engine=None,
+                 blocks_per_epoch: int = 32768):
+        """engine: chain.engine.Engine or None (no seal checks — tests
+        and block production before wiring consensus)."""
+        self.db = db
+        self.genesis = genesis
+        self.config = genesis.config
+        self.shard_id = genesis.shard_id
+        self.engine = engine
+        self.blocks_per_epoch = blocks_per_epoch
+        self.processor = StateProcessor(self.config.chain_id, self.shard_id)
+        head = rawdb.read_head_number(db)
+        if head is None:
+            self._init_genesis()
+        else:
+            self._head_num = head
+            self._state = self._load_state_at(head)
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def _init_genesis(self):
+        block = self.genesis.build_block()
+        state = self.genesis.build_state()
+        rawdb.write_block(self.db, block, self.config.chain_id)
+        rawdb.write_state(self.db, block.header.root, state.serialize())
+        rawdb.write_head_number(self.db, 0)
+        self._head_num = 0
+        self._state = state
+
+    def _load_state_at(self, num: int) -> StateDB:
+        header = rawdb.read_header(self.db, num)
+        if header is None:
+            raise ChainError(f"missing header {num}")
+        blob = rawdb.read_state(self.db, header.root)
+        if blob is None:
+            raise ChainError(f"missing state for root at block {num}")
+        return StateDB.deserialize(blob)
+
+    # -- reads -------------------------------------------------------------
+
+    @property
+    def head_number(self) -> int:
+        return self._head_num
+
+    def current_header(self) -> Header:
+        return rawdb.read_header(self.db, self._head_num)
+
+    def current_block(self) -> Block:
+        return rawdb.read_block(self.db, self._head_num)
+
+    def header_by_number(self, num: int) -> Header | None:
+        return rawdb.read_header(self.db, num)
+
+    def block_by_number(self, num: int) -> Block | None:
+        return rawdb.read_block(self.db, num)
+
+    def block_by_hash(self, block_hash: bytes) -> Block | None:
+        num = rawdb.read_block_number(self.db, block_hash)
+        return None if num is None else rawdb.read_block(self.db, num)
+
+    def state(self) -> StateDB:
+        """The CURRENT state (a live reference; copy() to speculate)."""
+        return self._state
+
+    def state_at(self, num: int) -> StateDB:
+        return self._load_state_at(num)
+
+    def epoch_of(self, num: int) -> int:
+        return num // self.blocks_per_epoch
+
+    def is_epoch_boundary(self, num: int) -> bool:
+        return num % self.blocks_per_epoch == 0 and num > 0
+
+    def read_commit_sig(self, num: int) -> bytes | None:
+        return rawdb.read_commit_sig(self.db, num)
+
+    def write_commit_sig(self, num: int, sig_and_bitmap: bytes):
+        rawdb.write_commit_sig(self.db, num, sig_and_bitmap)
+
+    def outgoing_cx(self, to_shard: int, num: int) -> list:
+        return rawdb.read_outgoing_cx(self.db, to_shard, num)
+
+    # -- insertion ---------------------------------------------------------
+
+    def _verify_structure(self, block: Block, parent: Header):
+        h = block.header
+        if h.block_num != parent.block_num + 1:
+            raise ChainError(
+                f"non-sequential block {h.block_num} on {parent.block_num}"
+            )
+        if h.parent_hash != parent.hash():
+            raise ChainError("parent hash mismatch")
+        if h.shard_id != self.shard_id:
+            raise ChainError("wrong shard")
+        if h.epoch != self.epoch_of(h.block_num):
+            raise ChainError("wrong epoch for block number")
+        if block.tx_root(self.config.chain_id) != h.tx_root:
+            raise ChainError("tx root does not commit to the body")
+
+    def _execute(self, block: Block) -> tuple[StateDB, object]:
+        state = self._state.copy()
+        epoch = block.header.epoch
+        result = self.processor.process(state, block, epoch)
+        if self.is_epoch_boundary(block.block_num):
+            self.processor.payout_undelegations(state, epoch)
+        if state.root() != block.header.root:
+            raise ChainError("state root mismatch after execution")
+        return state, result
+
+    def insert_chain(self, blocks: list, commit_sigs: list | None = None,
+                     verify_seals: bool = True) -> int:
+        """Insert consecutive blocks; returns how many were inserted.
+
+        ``commit_sigs[i]`` is the [96B sig || bitmap] proof for
+        blocks[i]; where None, the proof is taken from blocks[i+1]'s
+        header (the replay pattern — sig_verify.go:37-48).  Seal
+        verification is batched across the insert through the engine.
+        """
+        if not blocks:
+            return 0
+        if commit_sigs is None:
+            commit_sigs = [None] * len(blocks)
+
+        # structural pass + proof resolution
+        parent = self.current_header()
+        proofs = []
+        for i, block in enumerate(blocks):
+            self._verify_structure(block, parent)
+            proof = commit_sigs[i]
+            if proof is None:
+                nxt = (blocks[i + 1].header if i + 1 < len(blocks) else None)
+                if nxt is not None and nxt.last_commit_sig:
+                    proof = nxt.last_commit_sig + nxt.last_commit_bitmap
+            proofs.append(proof)
+            parent = block.header
+
+        if verify_seals:
+            if self.engine is None:
+                raise ChainError("no engine wired; verify_seals=True")
+            items, flags = [], []
+            for block, proof in zip(blocks, proofs):
+                if proof is None:
+                    raise ChainError(
+                        f"no commit proof for block {block.block_num}"
+                    )
+                sig, bitmap = proof[:96], proof[96:]
+                items.append((block.header, sig, bitmap))
+                flags.append(self.config.is_staking(block.header.epoch))
+            ok = self.engine.verify_headers_batch(items, flags)
+            for block, good in zip(blocks, ok):
+                if not good:
+                    raise ChainError(
+                        f"bad commit signature on block {block.block_num}"
+                    )
+
+        # execution + persistence pass
+        inserted = 0
+        for block, proof in zip(blocks, proofs):
+            state, result = self._execute(block)
+            rawdb.write_block(self.db, block, self.config.chain_id)
+            rawdb.write_state(self.db, block.header.root, state.serialize())
+            if proof is not None:
+                rawdb.write_commit_sig(self.db, block.block_num, proof)
+            by_shard: dict[int, list] = {}
+            for cx in result.outgoing_cx:
+                by_shard.setdefault(cx.to_shard, []).append(cx)
+            for to_shard, cxs in by_shard.items():
+                rawdb.write_outgoing_cx(
+                    self.db, to_shard, block.block_num, cxs
+                )
+            rawdb.write_head_number(self.db, block.block_num)
+            self._head_num = block.block_num
+            self._state = state
+            inserted += 1
+        return inserted

@@ -1,0 +1,70 @@
+"""Genesis: the spec that deterministically produces block 0.
+
+The role of the reference's core/genesis.go + genesis_initializer.go +
+internal/genesis (hard-coded foundational accounts and BLS keys —
+SURVEY.md §2.6): an account allocation, the initial committee, and the
+chain config, hashed into a reproducible genesis header.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..chain.header import Header
+from ..config.chain import ChainConfig
+from .state import StateDB
+from .types import Block
+
+
+@dataclass
+class Genesis:
+    config: ChainConfig
+    shard_id: int
+    alloc: dict = field(default_factory=dict)  # address -> balance
+    committee: list = field(default_factory=list)  # 48B BLS pubkeys
+    timestamp: int = 0
+    extra: bytes = b"harmony-tpu-genesis"
+
+    def build_state(self) -> StateDB:
+        state = StateDB()
+        for addr, balance in sorted(self.alloc.items()):
+            state.add_balance(addr, balance)
+        return state
+
+    def build_block(self) -> Block:
+        state = self.build_state()
+        header = Header(
+            shard_id=self.shard_id,
+            block_num=0,
+            epoch=0,
+            view_id=0,
+            parent_hash=bytes(32),
+            root=state.root(),
+            timestamp=self.timestamp,
+            extra=self.extra + b"".join(self.committee),
+        )
+        return Block(header)
+
+
+def dev_genesis(n_accounts: int = 4, n_keys: int = 4,
+                shard_id: int = 0) -> tuple[Genesis, list, list]:
+    """A deterministic localnet genesis: funded ECDSA accounts + a BLS
+    committee (the test/deploy.sh localnet role — SURVEY.md §4).
+    Returns (genesis, ecdsa_keys, bls_secret_keys)."""
+    from .. import bls as B
+    from ..crypto_ecdsa import ECDSAKey
+
+    ecdsa_keys = [
+        ECDSAKey.from_seed(b"harmony-tpu-dev-%d" % i)
+        for i in range(n_accounts)
+    ]
+    bls_keys = [B.PrivateKey.generate(b"harmony-tpu-dev-bls-%d" % i)
+                for i in range(n_keys)]
+    committee = [k.pub.bytes for k in bls_keys]
+    genesis = Genesis(
+        config=ChainConfig(chain_id=2),
+        shard_id=shard_id,
+        alloc={k.address(): 10**24 for k in ecdsa_keys},
+        committee=committee,
+    )
+    return genesis, ecdsa_keys, bls_keys

@@ -1,0 +1,170 @@
+"""Key/value storage: the persistence substrate under rawdb.
+
+The role of the reference's LevelDB layer (reference: core/rawdb over
+goleveldb; one DB per shard via internal/shardchain/shardchains.go).
+Two implementations behind one tiny interface:
+
+- ``MemKV`` — dict-backed, for tests and ephemeral chains (the
+  reference's rawdb.NewMemoryDatabase test pattern);
+- ``FileKV`` — a log-structured store: append-only record log with an
+  in-memory index, crash-safe reopen by log replay, and explicit
+  ``compact()`` that rewrites live records.  Single-writer by design
+  (the node owns its shard DB exclusively, as in the reference).
+
+Record format (little-endian): [klen u32][vlen u32 | 0xFFFFFFFF =
+tombstone][key][value].
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+_TOMB = 0xFFFFFFFF
+_HDR = struct.Struct("<II")
+
+
+class MemKV:
+    """Dict-backed store."""
+
+    def __init__(self):
+        self._d: dict[bytes, bytes] = {}
+
+    def get(self, key: bytes):
+        return self._d.get(key)
+
+    def put(self, key: bytes, value: bytes):
+        self._d[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes):
+        self._d.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._d
+
+    def items(self):
+        return list(self._d.items())
+
+    def close(self):
+        pass
+
+    def __len__(self):
+        return len(self._d)
+
+
+class FileKV:
+    """Append-only log + in-memory index."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._index: dict[bytes, tuple[int, int]] = {}  # key -> (off, vlen)
+        exists = os.path.exists(path)
+        self._f = open(path, "r+b" if exists else "w+b")
+        if exists:
+            self._replay()
+        self._f.seek(0, os.SEEK_END)
+
+    def _replay(self):
+        f = self._f
+        f.seek(0)
+        while True:
+            pos = f.tell()
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                f.truncate(pos)  # drop a torn tail record
+                break
+            klen, vlen = _HDR.unpack(hdr)
+            key = f.read(klen)
+            if len(key) < klen:
+                f.truncate(pos)
+                break
+            if vlen == _TOMB:
+                self._index.pop(key, None)
+                continue
+            voff = f.tell()
+            val = f.read(vlen)
+            if len(val) < vlen:
+                f.truncate(pos)
+                break
+            self._index[key] = (voff, vlen)
+
+    def get(self, key: bytes):
+        loc = self._index.get(key)
+        if loc is None:
+            return None
+        off, vlen = loc
+        end = self._f.tell()
+        self._f.seek(off)
+        val = self._f.read(vlen)
+        self._f.seek(end)
+        return val
+
+    def put(self, key: bytes, value: bytes):
+        key, value = bytes(key), bytes(value)
+        self._f.write(_HDR.pack(len(key), len(value)))
+        self._f.write(key)
+        voff = self._f.tell()
+        self._f.write(value)
+        self._index[key] = (voff, len(value))
+
+    def delete(self, key: bytes):
+        if key in self._index:
+            key = bytes(key)
+            self._f.write(_HDR.pack(len(key), _TOMB))
+            self._f.write(key)
+            del self._index[key]
+
+    def has(self, key: bytes) -> bool:
+        return key in self._index
+
+    def items(self):
+        return [(k, self.get(k)) for k in list(self._index)]
+
+    def flush(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def compact(self):
+        """Rewrite live records; reclaims tombstones + stale puts."""
+        tmp = self.path + ".compact"
+        live = self.items()
+        with open(tmp, "wb") as out:
+            for k, v in live:
+                out.write(_HDR.pack(len(k), len(v)) + k + v)
+            out.flush()
+            os.fsync(out.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "r+b")
+        self._index.clear()
+        self._replay()
+        self._f.seek(0, os.SEEK_END)
+
+    def close(self):
+        self._f.flush()
+        self._f.close()
+
+    def __len__(self):
+        return len(self._index)
+
+
+class ShardedCollection:
+    """One DB per shard id (reference: internal/shardchain/
+    shardchains.go CollectionImpl)."""
+
+    def __init__(self, factory):
+        """factory(shard_id) -> KV store."""
+        self._factory = factory
+        self._dbs: dict[int, object] = {}
+
+    def shard_db(self, shard_id: int):
+        db = self._dbs.get(shard_id)
+        if db is None:
+            db = self._factory(shard_id)
+            self._dbs[shard_id] = db
+        return db
+
+    def close_all(self):
+        for db in self._dbs.values():
+            db.close()
+        self._dbs.clear()

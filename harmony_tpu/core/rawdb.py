@@ -1,0 +1,252 @@
+"""rawdb: the key/value schema and block/state codecs.
+
+The role of the reference's core/rawdb (LevelDB schema: canonical
+hashes, headers, bodies, head pointers, and the per-block commit
+sig+bitmap consumed at consensus/validator.go:367-377 — SURVEY.md
+§2.4).  All keys are prefix-tagged; all values use the framework's
+canonical little-endian layout.
+"""
+
+from __future__ import annotations
+
+from ..chain.header import Header
+from .types import (
+    Block,
+    CXReceipt,
+    Reader as _Reader,
+    StakingTransaction,
+    Transaction,
+    _enc_bytes,
+    _enc_int,
+)
+
+# key prefixes
+_HEADER = b"h"          # h || num(8) -> header blob
+_BODY = b"b"            # b || num(8) -> body blob
+_CANON = b"n"           # n || num(8) -> 32-byte hash
+_NUM_BY_HASH = b"H"     # H || hash -> num(8)
+_COMMIT_SIG = b"s"      # s || num(8) -> [96B sig || bitmap]
+_HEAD = b"LastBlock"    # -> num(8)
+_STATE = b"S"           # S || root -> serialized StateDB
+_CX = b"x"              # x || to_shard(4) || num(8) -> outgoing cx blob
+
+
+# -- codecs -----------------------------------------------------------------
+
+def encode_header(h: Header) -> bytes:
+    return (
+        _enc_bytes(h.signing_fields())
+        + _enc_bytes(h.last_commit_sig)
+        + _enc_bytes(h.last_commit_bitmap)
+    )
+
+
+def decode_header(blob: bytes) -> Header:
+    r = _Reader(blob)
+    fields = _Reader(r.bytes_())
+    shard_id = fields.int_()
+    block_num = fields.int_()
+    epoch = fields.int_()
+    view_id = fields.int_()
+    timestamp = fields.int_()
+    parent_hash = fields.raw(32)
+    root = fields.raw(32)
+    tx_root = fields.raw(32)
+    extra = fields.bytes_()
+    return Header(
+        shard_id=shard_id, block_num=block_num, epoch=epoch,
+        view_id=view_id, parent_hash=parent_hash, root=root,
+        tx_root=tx_root, timestamp=timestamp, last_commit_sig=r.bytes_(),
+        last_commit_bitmap=r.bytes_(), extra=extra,
+    )
+
+
+def encode_tx(tx: Transaction, chain_id: int) -> bytes:
+    return _enc_bytes(tx.signing_bytes(chain_id)) + _enc_bytes(tx.sig)
+
+
+def decode_tx(blob: bytes) -> Transaction:
+    r = _Reader(blob)
+    f = _Reader(r.bytes_())
+    f.int_()  # chain id (re-derived from config at use sites)
+    nonce = f.int_()
+    gas_price = f.big_()
+    gas_limit = f.int_()
+    shard_id = f.int_(4)
+    to_shard = f.int_(4)
+    to = f.bytes_()
+    value = f.big_()
+    data = f.bytes_()
+    return Transaction(
+        nonce=nonce, gas_price=gas_price, gas_limit=gas_limit,
+        shard_id=shard_id, to_shard=to_shard,
+        to=(to if to else None), value=value, data=data, sig=r.bytes_(),
+    )
+
+
+def encode_staking_tx(tx: StakingTransaction, chain_id: int) -> bytes:
+    return _enc_bytes(tx.signing_bytes(chain_id)) + _enc_bytes(tx.sig)
+
+
+def decode_staking_tx(blob: bytes) -> StakingTransaction:
+    from .types import Directive
+
+    r = _Reader(blob)
+    f = _Reader(r.bytes_())
+    f.int_()  # chain id
+    nonce = f.int_()
+    gas_price = f.big_()
+    gas_limit = f.int_()
+    directive = Directive(f.int_(1))
+    fields = {}
+    while f.off < len(f.view):
+        key = f.bytes_().decode()
+        tag = f.int_(1)
+        if tag == 0:
+            fields[key] = f.bytes_()
+        elif tag == 1:
+            fields[key] = f.big_()
+        else:
+            fields[key] = f.bytes_().decode()
+    return StakingTransaction(
+        nonce=nonce, gas_price=gas_price, gas_limit=gas_limit,
+        directive=directive, fields=fields, sig=r.bytes_(),
+    )
+
+
+def encode_cx(cx: CXReceipt) -> bytes:
+    return cx.encode()
+
+
+def decode_cx(blob: bytes) -> CXReceipt:
+    r = _Reader(blob)
+    return CXReceipt(
+        tx_hash=r.bytes_(), sender=r.bytes_(), to=r.bytes_(),
+        amount=r.big_(), from_shard=r.int_(4), to_shard=r.int_(4),
+        block_num=r.int_(),
+    )
+
+
+def encode_body(block: Block, chain_id: int) -> bytes:
+    out = bytearray()
+    out += _enc_int(len(block.transactions), 4)
+    for tx in block.transactions:
+        out += _enc_bytes(encode_tx(tx, chain_id))
+    out += _enc_int(len(block.staking_transactions), 4)
+    for stx in block.staking_transactions:
+        out += _enc_bytes(encode_staking_tx(stx, chain_id))
+    out += _enc_int(len(block.incoming_receipts), 4)
+    for cx in block.incoming_receipts:
+        out += _enc_bytes(encode_cx(cx))
+    out += _enc_int(len(block.execution_order), 4)
+    out += bytes(block.execution_order)
+    return bytes(out)
+
+
+def decode_body(blob: bytes):
+    r = _Reader(blob)
+    txs = [decode_tx(r.bytes_()) for _ in range(r.int_(4))]
+    stxs = [decode_staking_tx(r.bytes_()) for _ in range(r.int_(4))]
+    cxs = [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    order = list(r.raw(r.int_(4)))
+    return txs, stxs, cxs, order
+
+
+# -- schema accessors -------------------------------------------------------
+
+def _num_key(prefix: bytes, num: int) -> bytes:
+    return prefix + num.to_bytes(8, "little")
+
+
+def write_block(db, block: Block, chain_id: int):
+    num = block.block_num
+    db.put(_num_key(_HEADER, num), encode_header(block.header))
+    db.put(_num_key(_BODY, num), encode_body(block, chain_id))
+    db.put(_num_key(_CANON, num), block.hash())
+    db.put(_NUM_BY_HASH + block.hash(), num.to_bytes(8, "little"))
+
+
+def read_block(db, num: int) -> Block | None:
+    hdr_blob = db.get(_num_key(_HEADER, num))
+    if hdr_blob is None:
+        return None
+    header = decode_header(hdr_blob)
+    body = db.get(_num_key(_BODY, num))
+    txs, stxs, cxs, order = (
+        decode_body(body) if body else ([], [], [], [])
+    )
+    return Block(header, txs, stxs, cxs, order)
+
+
+def read_header(db, num: int) -> Header | None:
+    blob = db.get(_num_key(_HEADER, num))
+    return decode_header(blob) if blob else None
+
+
+def read_canonical_hash(db, num: int) -> bytes | None:
+    return db.get(_num_key(_CANON, num))
+
+
+def read_block_number(db, block_hash: bytes) -> int | None:
+    blob = db.get(_NUM_BY_HASH + block_hash)
+    return int.from_bytes(blob, "little") if blob else None
+
+
+def write_commit_sig(db, num: int, sig_and_bitmap: bytes):
+    """reference: BlockChain.WriteCommitSig (consensus/validator.go:
+    367-377 reads it back for the last-mile path)."""
+    db.put(_num_key(_COMMIT_SIG, num), sig_and_bitmap)
+
+
+def read_commit_sig(db, num: int) -> bytes | None:
+    return db.get(_num_key(_COMMIT_SIG, num))
+
+
+def write_head_number(db, num: int):
+    db.put(_HEAD, num.to_bytes(8, "little"))
+
+
+def read_head_number(db) -> int | None:
+    blob = db.get(_HEAD)
+    return int.from_bytes(blob, "little") if blob else None
+
+
+def write_state(db, root: bytes, state_blob: bytes):
+    db.put(_STATE + root, state_blob)
+
+
+def read_state(db, root: bytes) -> bytes | None:
+    return db.get(_STATE + root)
+
+
+def write_outgoing_cx(db, to_shard: int, num: int, cxs: list):
+    out = bytearray(_enc_int(len(cxs), 4))
+    for cx in cxs:
+        out += _enc_bytes(encode_cx(cx))
+    db.put(_CX + to_shard.to_bytes(4, "little") + num.to_bytes(8, "little"),
+           bytes(out))
+
+
+def read_outgoing_cx(db, to_shard: int, num: int) -> list:
+    blob = db.get(
+        _CX + to_shard.to_bytes(4, "little") + num.to_bytes(8, "little")
+    )
+    if blob is None:
+        return []
+    r = _Reader(blob)
+    return [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+
+
+def encode_block(block: Block, chain_id: int) -> bytes:
+    """Standalone block blob (gossip ANNOUNCE carries this)."""
+    return (
+        _enc_bytes(encode_header(block.header))
+        + _enc_bytes(encode_body(block, chain_id))
+    )
+
+
+def decode_block(blob: bytes) -> Block:
+    r = _Reader(blob)
+    header = decode_header(r.bytes_())
+    txs, stxs, cxs, order = decode_body(r.bytes_())
+    return Block(header, txs, stxs, cxs, order)

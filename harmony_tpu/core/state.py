@@ -1,0 +1,221 @@
+"""Account-model state DB with a deterministic root.
+
+The role of the reference's core/state (go-ethereum-style StateDB with
+an MPT + snapshot tree, plus ValidatorWrapper storage — SURVEY.md
+§2.4), redesigned: a flat account map with copy-on-commit journaling
+and a root that is keccak-256 over the sorted canonical serialization
+of all accounts.  The flat layout trades MPT inclusion proofs (not
+consumed anywhere in the reference's consensus path) for O(1) access
+and a trivially parallelizable root computation.
+
+ValidatorWrapper (reference: staking ValidatorWrapper in state) is a
+first-class part of the account record here: description, delegations
+(ordered), and signing counters serialize into the root so staking
+state is consensus-committed exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..ref.keccak import keccak256
+from .types import Reader, _enc_big, _enc_bytes, _enc_int
+
+
+@dataclass
+class Delegation:
+    delegator: bytes  # 20-byte address
+    amount: int
+    undelegations: list = field(default_factory=list)  # (amount, epoch)
+    reward: int = 0
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += _enc_bytes(self.delegator) + _enc_big(self.amount)
+        out += _enc_big(self.reward)
+        out += _enc_int(len(self.undelegations), 4)
+        for amount, epoch in self.undelegations:
+            out += _enc_big(amount) + _enc_int(epoch)
+        return bytes(out)
+
+
+@dataclass
+class ValidatorWrapper:
+    """On-chain validator record (reference: staking/types validator +
+    wrapper: keys, commission, delegations, signing counters)."""
+
+    address: bytes
+    bls_keys: list = field(default_factory=list)  # 48-byte serialized
+    commission_rate: int = 0  # scaled 1e18
+    max_commission_rate: int = 10**18
+    max_change_rate: int = 10**18
+    min_self_delegation: int = 0
+    max_total_delegation: int = 0
+    delegations: list = field(default_factory=list)  # [Delegation]
+    blocks_signed: int = 0
+    blocks_to_sign: int = 0
+    status: int = 0  # 0 active, 1 inactive, 2 banned
+    last_epoch_in_committee: int = 0
+
+    def total_delegation(self) -> int:
+        return sum(d.amount for d in self.delegations)
+
+    def self_delegation(self) -> int:
+        for d in self.delegations:
+            if d.delegator == self.address:
+                return d.amount
+        return 0
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += _enc_bytes(self.address)
+        out += _enc_int(len(self.bls_keys), 4)
+        for k in self.bls_keys:
+            out += _enc_bytes(k)
+        for v in (self.commission_rate, self.max_commission_rate,
+                  self.max_change_rate, self.min_self_delegation,
+                  self.max_total_delegation):
+            out += _enc_big(v)
+        out += _enc_int(len(self.delegations), 4)
+        for d in self.delegations:
+            out += d.encode()
+        out += _enc_int(self.blocks_signed) + _enc_int(self.blocks_to_sign)
+        out += _enc_int(self.status, 1)
+        out += _enc_int(self.last_epoch_in_committee)
+        return bytes(out)
+
+
+@dataclass
+class Account:
+    balance: int = 0
+    nonce: int = 0
+    validator: ValidatorWrapper | None = None
+
+    def encode(self) -> bytes:
+        out = _enc_big(self.balance) + _enc_int(self.nonce)
+        if self.validator is not None:
+            out += b"\x01" + self.validator.encode()
+        else:
+            out += b"\x00"
+        return out
+
+
+class StateDB:
+    """Mutable state with snapshot/revert and a deterministic root."""
+
+    def __init__(self, accounts: dict | None = None):
+        self._accounts: dict[bytes, Account] = accounts or {}
+
+    # -- access ------------------------------------------------------------
+
+    def account(self, addr: bytes) -> Account:
+        acct = self._accounts.get(addr)
+        if acct is None:
+            acct = Account()
+            self._accounts[addr] = acct
+        return acct
+
+    def balance(self, addr: bytes) -> int:
+        a = self._accounts.get(addr)
+        return a.balance if a else 0
+
+    def nonce(self, addr: bytes) -> int:
+        a = self._accounts.get(addr)
+        return a.nonce if a else 0
+
+    def add_balance(self, addr: bytes, amount: int):
+        self.account(addr).balance += amount
+
+    def sub_balance(self, addr: bytes, amount: int):
+        acct = self.account(addr)
+        if acct.balance < amount:
+            raise ValueError("insufficient balance")
+        acct.balance -= amount
+
+    def set_nonce(self, addr: bytes, nonce: int):
+        self.account(addr).nonce = nonce
+
+    def validator(self, addr: bytes) -> ValidatorWrapper | None:
+        a = self._accounts.get(addr)
+        return a.validator if a else None
+
+    def set_validator(self, wrapper: ValidatorWrapper):
+        self.account(wrapper.address).validator = wrapper
+
+    def validator_addresses(self) -> list:
+        return sorted(
+            addr for addr, a in self._accounts.items() if a.validator
+        )
+
+    # -- snapshots ---------------------------------------------------------
+
+    def copy(self) -> "StateDB":
+        import copy as _copy
+
+        return StateDB(_copy.deepcopy(self._accounts))
+
+    # -- root --------------------------------------------------------------
+
+    def root(self) -> bytes:
+        """keccak over sorted (address, account) serializations."""
+        out = bytearray()
+        for addr in sorted(self._accounts):
+            acct = self._accounts[addr]
+            if acct.balance == 0 and acct.nonce == 0 and not acct.validator:
+                continue  # empty accounts don't affect the root
+            out += _enc_bytes(addr) + _enc_bytes(acct.encode())
+        return keccak256(bytes(out))
+
+    # -- persistence -------------------------------------------------------
+
+    def serialize(self) -> bytes:
+        out = bytearray()
+        live = [
+            (a, acct) for a, acct in sorted(self._accounts.items())
+            if acct.balance or acct.nonce or acct.validator
+        ]
+        out += _enc_int(len(live), 4)
+        for addr, acct in live:
+            out += _enc_bytes(addr) + _enc_bytes(acct.encode())
+        return bytes(out)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "StateDB":
+        r = Reader(data)
+        n = r.int_(4)
+        accounts = {}
+        for _ in range(n):
+            addr = r.bytes_()
+            blob = r.bytes_()
+            accounts[addr] = _decode_account(blob)
+        return cls(accounts)
+
+
+def _decode_account(blob: bytes) -> Account:
+    r = Reader(blob)
+    balance = r.big_()
+    nonce = r.int_()
+    has_val = r.int_(1)
+    validator = None
+    if has_val:
+        address = r.bytes_()
+        keys = [r.bytes_() for _ in range(r.int_(4))]
+        rates = [r.big_() for _ in range(5)]
+        delegations = []
+        for _ in range(r.int_(4)):
+            delegator = r.bytes_()
+            amount = r.big_()
+            reward = r.big_()
+            undel = [(r.big_(), r.int_()) for _ in range(r.int_(4))]
+            delegations.append(
+                Delegation(delegator, amount, undel, reward)
+            )
+        signed = r.int_()
+        to_sign = r.int_()
+        status = r.int_(1)
+        last_epoch = r.int_()
+        validator = ValidatorWrapper(
+            address, keys, rates[0], rates[1], rates[2], rates[3],
+            rates[4], delegations, signed, to_sign, status, last_epoch,
+        )
+    return Account(balance, nonce, validator)

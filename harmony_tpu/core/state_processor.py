@@ -1,0 +1,311 @@
+"""Block execution: transfers, staking directives, cross-shard receipts.
+
+The role of the reference's core/state_processor.go (699 LoC: tx,
+staking-tx, and incoming-CXReceipt application) plus the staking
+message validation of core/staking_verifier.go (SURVEY.md §2.4).  The
+EVM itself is out of the v1 execution scope (SURVEY.md §7 non-goals);
+``data`` payloads are carried, charged for, and ignored.
+
+Gas model (the subset consensus needs to be deterministic about):
+intrinsic 21_000 per plain tx + 68/non-zero byte + 4/zero byte of
+data; staking directives cost a flat intrinsic each.  Fees are burned
+here (reward issuance is the engine's job at Finalize, as in the
+reference's reward.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .state import Delegation, StateDB, ValidatorWrapper
+from .types import (
+    CXReceipt,
+    Directive,
+    Receipt,
+    StakingTransaction,
+    Transaction,
+)
+
+INTRINSIC_GAS = 21_000
+STAKING_GAS = 21_000
+DATA_GAS_NONZERO = 68
+DATA_GAS_ZERO = 4
+UNDELEGATION_LOCK_EPOCHS = 7  # reference: staking undelegation maturity
+
+
+class ExecutionError(ValueError):
+    pass
+
+
+def intrinsic_gas(tx: Transaction) -> int:
+    g = INTRINSIC_GAS
+    for b in tx.data:
+        g += DATA_GAS_NONZERO if b else DATA_GAS_ZERO
+    return g
+
+
+@dataclass
+class ProcessResult:
+    receipts: list = field(default_factory=list)
+    staking_receipts: list = field(default_factory=list)
+    outgoing_cx: list = field(default_factory=list)  # CXReceipts to export
+    gas_used: int = 0
+
+
+class StateProcessor:
+    """Applies a block's transactions to a StateDB."""
+
+    def __init__(self, chain_id: int, shard_id: int):
+        self.chain_id = chain_id
+        self.shard_id = shard_id
+
+    # -- plain transactions ------------------------------------------------
+
+    def apply_transaction(
+        self, state: StateDB, tx: Transaction, block_num: int,
+        cumulative_gas: int,
+    ) -> tuple[Receipt, CXReceipt | None]:
+        try:
+            sender = tx.sender(self.chain_id)
+        except ValueError as e:
+            raise ExecutionError(f"bad signature: {e}") from e
+        if tx.shard_id != self.shard_id:
+            raise ExecutionError("tx for a different shard")
+        if tx.nonce != state.nonce(sender):
+            raise ExecutionError(
+                f"bad nonce: want {state.nonce(sender)} got {tx.nonce}"
+            )
+        gas = intrinsic_gas(tx)
+        if tx.gas_limit < gas:
+            raise ExecutionError("gas limit below intrinsic gas")
+        fee = gas * tx.gas_price
+        total = fee + tx.value
+        if state.balance(sender) < total:
+            raise ExecutionError("insufficient balance for value + fee")
+        state.sub_balance(sender, total)
+        state.set_nonce(sender, tx.nonce + 1)
+        cx = None
+        if tx.is_cross_shard():
+            cx = CXReceipt(
+                tx_hash=tx.hash(self.chain_id),
+                sender=sender,
+                to=tx.to or b"\x00" * 20,
+                amount=tx.value,
+                from_shard=tx.shard_id,
+                to_shard=tx.to_shard,
+                block_num=block_num,
+            )
+        elif tx.to is not None:
+            state.add_balance(tx.to, tx.value)
+        receipt = Receipt(
+            tx_hash=tx.hash(self.chain_id),
+            status=1,
+            gas_used=gas,
+            cumulative_gas=cumulative_gas + gas,
+        )
+        return receipt, cx
+
+    def apply_incoming_receipt(self, state: StateDB, cx: CXReceipt):
+        """Credit a cross-shard transfer on its destination shard
+        (reference: core/state_processor ApplyIncomingReceipt)."""
+        if cx.to_shard != self.shard_id:
+            raise ExecutionError("cx receipt for a different shard")
+        state.add_balance(cx.to, cx.amount)
+
+    # -- staking directives ------------------------------------------------
+
+    def apply_staking_transaction(
+        self, state: StateDB, tx: StakingTransaction, epoch: int,
+        cumulative_gas: int,
+    ) -> Receipt:
+        """Atomic: on any failure ``state`` is left untouched (so a
+        proposer can skip a failing tx without poisoning its
+        speculative state — the root it seals must match replay)."""
+        try:
+            sender = tx.sender(self.chain_id)
+        except ValueError as e:
+            raise ExecutionError(f"bad signature: {e}") from e
+        if tx.nonce != state.nonce(sender):
+            raise ExecutionError(
+                f"bad nonce: want {state.nonce(sender)} got {tx.nonce}"
+            )
+        if tx.gas_limit < STAKING_GAS:
+            raise ExecutionError("gas limit below staking intrinsic gas")
+        fee = STAKING_GAS * tx.gas_price
+        if state.balance(sender) < fee:
+            raise ExecutionError("insufficient balance for fee")
+        work = state.copy()
+        work.sub_balance(sender, fee)
+        work.set_nonce(sender, tx.nonce + 1)
+        handler = {
+            Directive.CREATE_VALIDATOR: self._create_validator,
+            Directive.EDIT_VALIDATOR: self._edit_validator,
+            Directive.DELEGATE: self._delegate,
+            Directive.UNDELEGATE: self._undelegate,
+            Directive.COLLECT_REWARDS: self._collect_rewards,
+        }[tx.directive]
+        try:
+            handler(work, sender, tx.fields, epoch)
+        except ExecutionError:
+            raise
+        except (ValueError, KeyError, TypeError) as e:
+            raise ExecutionError(f"{tx.directive.name}: {e}") from e
+        state._accounts = work._accounts
+        return Receipt(
+            tx_hash=tx.hash(self.chain_id),
+            status=1,
+            gas_used=STAKING_GAS,
+            cumulative_gas=cumulative_gas + STAKING_GAS,
+        )
+
+    # validation rules mirror core/staking_verifier.go (SURVEY.md §2.4)
+
+    def _create_validator(self, state, sender, f, epoch):
+        if state.validator(sender) is not None:
+            raise ExecutionError("validator already exists")
+        amount = int(f.get("amount", 0))
+        min_self = int(f.get("min_self_delegation", 0))
+        if amount <= 0 or min_self < 0:
+            raise ExecutionError("self-delegation must be positive")
+        if amount < min_self:
+            raise ExecutionError("initial self-delegation below minimum")
+        keys = f.get("bls_keys")
+        if not keys:
+            raise ExecutionError("create-validator needs >=1 BLS key")
+        if isinstance(keys, bytes):  # packed 48-byte keys
+            keys = [keys[i:i + 48] for i in range(0, len(keys), 48)]
+        if state.balance(sender) < amount:
+            raise ExecutionError("insufficient balance for self-delegation")
+        state.sub_balance(sender, amount)
+        wrapper = ValidatorWrapper(
+            address=sender,
+            bls_keys=list(keys),
+            commission_rate=int(f.get("commission_rate", 0)),
+            max_commission_rate=int(f.get("max_commission_rate", 10**18)),
+            max_change_rate=int(f.get("max_change_rate", 10**18)),
+            min_self_delegation=min_self,
+            max_total_delegation=int(f.get("max_total_delegation", 0)),
+            delegations=[Delegation(sender, amount)],
+            last_epoch_in_committee=epoch,
+        )
+        state.set_validator(wrapper)
+
+    def _edit_validator(self, state, sender, f, epoch):
+        w = state.validator(sender)
+        if w is None:
+            raise ExecutionError("no such validator")
+        if "commission_rate" in f:
+            new_rate = int(f["commission_rate"])
+            if new_rate > w.max_commission_rate:
+                raise ExecutionError("commission above max")
+            if abs(new_rate - w.commission_rate) > w.max_change_rate:
+                raise ExecutionError("commission change above max change")
+            w.commission_rate = new_rate
+        if "add_bls_key" in f:
+            k = f["add_bls_key"]
+            if k in w.bls_keys:
+                raise ExecutionError("key already registered")
+            w.bls_keys.append(k)
+        if "remove_bls_key" in f:
+            k = f["remove_bls_key"]
+            if k not in w.bls_keys:
+                raise ExecutionError("key not registered")
+            if len(w.bls_keys) == 1:
+                raise ExecutionError("cannot remove last BLS key")
+            w.bls_keys.remove(k)
+
+    def _delegate(self, state, sender, f, epoch):
+        validator = f["validator"]
+        amount = int(f["amount"])
+        w = state.validator(validator)
+        if w is None:
+            raise ExecutionError("no such validator")
+        if amount <= 0:
+            raise ExecutionError("delegation must be positive")
+        if w.max_total_delegation and (
+            w.total_delegation() + amount > w.max_total_delegation
+        ):
+            raise ExecutionError("exceeds max total delegation")
+        state.sub_balance(sender, amount)
+        for d in w.delegations:
+            if d.delegator == sender:
+                d.amount += amount
+                return
+        w.delegations.append(Delegation(sender, amount))
+
+    def _undelegate(self, state, sender, f, epoch):
+        validator = f["validator"]
+        amount = int(f["amount"])
+        w = state.validator(validator)
+        if w is None:
+            raise ExecutionError("no such validator")
+        if amount <= 0:
+            raise ExecutionError("undelegation must be positive")
+        for d in w.delegations:
+            if d.delegator == sender:
+                if d.amount < amount:
+                    raise ExecutionError("undelegate exceeds delegation")
+                d.amount -= amount
+                d.undelegations.append((amount, epoch))
+                if (
+                    validator == sender
+                    and d.amount < w.min_self_delegation
+                ):
+                    w.status = 1  # below self-delegation floor: inactive
+                return
+        raise ExecutionError("no delegation to undelegate")
+
+    def _collect_rewards(self, state, sender, f, epoch):
+        total = 0
+        for addr in state.validator_addresses():
+            w = state.validator(addr)
+            for d in w.delegations:
+                if d.delegator == sender and d.reward:
+                    total += d.reward
+                    d.reward = 0
+        if total == 0:
+            raise ExecutionError("no rewards to collect")
+        state.add_balance(sender, total)
+
+    # -- undelegation maturity (epoch boundary) ----------------------------
+
+    def payout_undelegations(self, state: StateDB, epoch: int):
+        """Release matured undelegations back to delegators (reference:
+        internal/chain/engine.go:359 payoutUndelegations)."""
+        for addr in state.validator_addresses():
+            w = state.validator(addr)
+            for d in w.delegations:
+                kept, released = [], 0
+                for amount, at_epoch in d.undelegations:
+                    if epoch >= at_epoch + UNDELEGATION_LOCK_EPOCHS:
+                        released += amount
+                    else:
+                        kept.append((amount, at_epoch))
+                if released:
+                    d.undelegations = kept
+                    state.add_balance(d.delegator, released)
+
+    # -- whole block -------------------------------------------------------
+
+    def process(
+        self, state: StateDB, block, epoch: int
+    ) -> ProcessResult:
+        """Execute a block against ``state`` (mutates it)."""
+        res = ProcessResult()
+        for tx, is_staking in block.ordered_txs():
+            if is_staking:
+                receipt = self.apply_staking_transaction(
+                    state, tx, epoch, res.gas_used
+                )
+                res.staking_receipts.append(receipt)
+            else:
+                receipt, cx = self.apply_transaction(
+                    state, tx, block.block_num, res.gas_used
+                )
+                res.receipts.append(receipt)
+                if cx is not None:
+                    res.outgoing_cx.append(cx)
+            res.gas_used += receipt.gas_used
+        for cx in block.incoming_receipts:
+            self.apply_incoming_receipt(state, cx)
+        return res

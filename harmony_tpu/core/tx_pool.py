@@ -1,0 +1,138 @@
+"""Mempool: nonce-ordered per sender, price-ordered across senders.
+
+The role of the reference's core/tx_pool.go (1,732 LoC incl. staking
+txs — SURVEY.md §2.4), reduced to the consensus-relevant contract:
+
+- ``add`` validates signature, nonce window, balance cover, and gas
+  floor, and replaces same-nonce txs only for a >=10% price bump
+  (the reference's price-bump rule);
+- ``pending`` yields executable txs: per sender a gapless nonce run
+  starting at the state nonce, senders interleaved by gas price;
+- ``drop_applied`` prunes txs at block commit.
+
+Plain and staking transactions share the pool with a common queue
+discipline (the reference keeps both in one pool as well).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+PRICE_BUMP_PCT = 10
+DEFAULT_POOL_CAP = 8192
+
+
+class PoolError(ValueError):
+    pass
+
+
+@dataclass
+class _Entry:
+    tx: object
+    sender: bytes
+    is_staking: bool
+
+
+class TxPool:
+    def __init__(self, chain_id: int, shard_id: int, state_view,
+                 cap: int = DEFAULT_POOL_CAP):
+        """state_view() -> StateDB-like with nonce()/balance()."""
+        self.chain_id = chain_id
+        self.shard_id = shard_id
+        self._state_view = state_view
+        self.cap = cap
+        # sender -> {nonce -> _Entry}
+        self._by_sender: dict[bytes, dict[int, _Entry]] = {}
+        self._count = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def _validate(self, tx, is_staking: bool) -> bytes:
+        try:
+            sender = tx.sender(self.chain_id)
+        except ValueError as e:
+            raise PoolError(f"bad signature: {e}") from e
+        if not is_staking and tx.shard_id != self.shard_id:
+            raise PoolError("wrong shard")
+        state = self._state_view()
+        if tx.nonce < state.nonce(sender):
+            raise PoolError("nonce too low")
+        if tx.gas_price < 1:
+            raise PoolError("gas price below floor")
+        if is_staking:
+            # delegated/self-staked amount must be covered up front
+            moved = int(tx.fields.get("amount", 0))
+        else:
+            moved = tx.value
+        cost = tx.gas_limit * tx.gas_price + moved
+        if state.balance(sender) < cost:
+            raise PoolError("insufficient balance for max cost")
+        return sender
+
+    def add(self, tx, is_staking: bool = False) -> bytes:
+        """Admit a tx; returns the recovered sender. Raises PoolError."""
+        sender = self._validate(tx, is_staking)
+        slots = self._by_sender.setdefault(sender, {})
+        old = slots.get(tx.nonce)
+        if old is not None:
+            bump = old.tx.gas_price * (100 + PRICE_BUMP_PCT) // 100
+            if tx.gas_price < max(bump, old.tx.gas_price + 1):
+                raise PoolError("replacement underpriced")
+            slots[tx.nonce] = _Entry(tx, sender, is_staking)
+            return sender
+        if self._count >= self.cap:
+            raise PoolError("pool full")
+        slots[tx.nonce] = _Entry(tx, sender, is_staking)
+        self._count += 1
+        return sender
+
+    # -- selection ---------------------------------------------------------
+
+    def pending(self, max_txs: int = 0):
+        """Executable (tx, is_staking) pairs: gapless nonce runs per
+        sender, merged by descending gas price (the proposer's read —
+        reference: node/harmony/worker block assembly)."""
+        state = self._state_view()
+        runs = []
+        for sender, slots in self._by_sender.items():
+            nonce = state.nonce(sender)
+            run = []
+            while nonce in slots:
+                run.append(slots[nonce])
+                nonce += 1
+            if run:
+                runs.append(run)
+        out = []
+        cursors = [0] * len(runs)
+        while True:
+            best, best_i = None, -1
+            for i, run in enumerate(runs):
+                if cursors[i] < len(run):
+                    e = run[cursors[i]]
+                    if best is None or e.tx.gas_price > best.tx.gas_price:
+                        best, best_i = e, i
+            if best is None:
+                break
+            out.append((best.tx, best.is_staking))
+            cursors[best_i] += 1
+            if max_txs and len(out) >= max_txs:
+                break
+        return out
+
+    # -- maintenance -------------------------------------------------------
+
+    def drop_applied(self):
+        """Prune txs whose nonce is now below the state nonce (called
+        after a block commits)."""
+        state = self._state_view()
+        for sender in list(self._by_sender):
+            slots = self._by_sender[sender]
+            floor = state.nonce(sender)
+            for nonce in [n for n in slots if n < floor]:
+                del slots[nonce]
+                self._count -= 1
+            if not slots:
+                del self._by_sender[sender]
+
+    def __len__(self):
+        return self._count

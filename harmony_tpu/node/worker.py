@@ -1,0 +1,91 @@
+"""Block assembly: the proposer's execution environment.
+
+The role of the reference's node/harmony/worker (reference:
+node/harmony/worker/worker.go:54-99 block-assembly env) + the proposal
+flow of consensus/consensus_block_proposing.go:25-254 (ProposeNewBlock:
+pull txs + staking txs + incoming cx receipts, execute speculatively,
+seal the header with the post-state root — SURVEY.md §2.2): take the
+chain tip, select from the mempool, run the state processor on a state
+copy, and emit a sealed-but-unsigned Block whose header is what the
+leader announces.
+"""
+
+from __future__ import annotations
+
+from ..chain.header import Header
+from ..core.state_processor import ExecutionError
+from ..core.types import Block
+
+DEFAULT_BLOCK_TX_CAP = 1024
+
+
+class Worker:
+    def __init__(self, chain, tx_pool=None):
+        self.chain = chain
+        self.tx_pool = tx_pool
+
+    def propose_block(
+        self,
+        view_id: int,
+        timestamp: int = 0,
+        incoming_receipts: list | None = None,
+        leader_extra: bytes = b"",
+        max_txs: int = DEFAULT_BLOCK_TX_CAP,
+    ) -> Block:
+        """Assemble the next block on the current tip.
+
+        Mempool selection is best-effort: a tx that fails execution is
+        skipped (and left for the pool's next prune), exactly as the
+        reference's worker drops failing txs from the proposal rather
+        than aborting it.
+        """
+        parent = self.chain.current_header()
+        num = parent.block_num + 1
+        epoch = self.chain.epoch_of(num)
+
+        plain, staking, order = [], [], []
+        state = self.chain.state().copy()
+        gas_used = 0
+        if self.tx_pool is not None:
+            for tx, is_staking in self.tx_pool.pending(max_txs):
+                try:
+                    if is_staking:
+                        receipt = (
+                            self.chain.processor.apply_staking_transaction(
+                                state, tx, epoch, gas_used
+                            )
+                        )
+                        staking.append(tx)
+                    else:
+                        receipt, cx = self.chain.processor.apply_transaction(
+                            state, tx, num, gas_used
+                        )
+                        plain.append(tx)
+                    order.append(1 if is_staking else 0)
+                    gas_used += receipt.gas_used
+                except ExecutionError:
+                    continue
+        for cx in incoming_receipts or []:
+            self.chain.processor.apply_incoming_receipt(state, cx)
+        if self.chain.is_epoch_boundary(num):
+            self.chain.processor.payout_undelegations(state, epoch)
+
+        block = Block(
+            None,
+            transactions=plain,
+            staking_transactions=staking,
+            incoming_receipts=list(incoming_receipts or []),
+            execution_order=order,
+        )
+        block.header = Header(
+            shard_id=self.chain.shard_id,
+            block_num=num,
+            epoch=epoch,
+            view_id=view_id,
+            parent_hash=parent.hash(),
+            root=state.root(),
+            tx_root=block.tx_root(self.chain.config.chain_id),
+            timestamp=timestamp,
+            extra=leader_extra,
+        )
+        return block
