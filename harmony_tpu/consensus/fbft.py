@@ -84,6 +84,27 @@ class Leader(_Node):
         self.log.add_message(msg)
         self.log.add_block(block_hash, block_bytes)
         self.current_block_hash = block_hash
+        # the leader's own prepare vote counts toward quorum at announce
+        # time (the reference's leader signs the block hash with all its
+        # keys alongside the announce — leader.go:20 + construct.go:124).
+        # Cast directly — no pairing check on a signature produced one
+        # line earlier; a stale committee is a hard wiring error.
+        own = [k.pub.bytes for k in self.keys]
+        committee = set(self.cfg.committee)
+        missing = [pk for pk in own if pk not in committee]
+        if missing:
+            raise ValueError(
+                f"leader key(s) not in committee: {len(missing)} of "
+                f"{len(own)}"
+            )
+        sig = self.keys.sign_hash_aggregated(prepare_payload(block_hash))
+        for pk in own:
+            self.decider.submit_vote(
+                Phase.PREPARE,
+                Ballot(pk, block_hash, sig.bytes,
+                       self.cfg.block_num, self.cfg.view_id),
+            )
+        self.prepare_sigs[tuple(own)] = sig
         return msg
 
     def _on_vote(self, msg, phase, payload, store):
@@ -147,7 +168,11 @@ class Leader(_Node):
 
     def try_prepared(self, block_hash: bytes):
         """At prepare quorum: broadcast PREPARED with the proof
-        (reference: consensus/threshold.go:14-52)."""
+        (reference: consensus/threshold.go:14-52).  Only the round's
+        announced block may be proven — a caller passing any other hash
+        (e.g. lifted from a rejected vote) gets None."""
+        if block_hash != self.current_block_hash:
+            return None
         if not self.decider.is_quorum_achieved(Phase.PREPARE):
             return None
         return FBFTMessage(
@@ -161,6 +186,8 @@ class Leader(_Node):
         )
 
     def try_committed(self, block_hash: bytes):
+        if block_hash != self.current_block_hash:
+            return None
         if not self.decider.is_quorum_achieved(Phase.COMMIT):
             return None
         return FBFTMessage(

@@ -21,7 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from enum import IntEnum
 
-from ..ref.params import SIG_BYTES
+from ..ref.params import PUBKEY_BYTES, SIG_BYTES
 
 
 class MsgType(IntEnum):
@@ -123,3 +123,55 @@ class FBFTLog:
             k: m for k, m in self._messages.items() if m.block_num >= block_num
         }
         return self
+
+
+# -- wire codec --------------------------------------------------------------
+
+def encode_message(msg: FBFTMessage) -> bytes:
+    """Canonical wire form (the payload inside the gossip envelope —
+    the reference uses protobuf harmonymessage.pb.go; this framework
+    uses its fixed little-endian layout)."""
+    out = bytearray()
+    out += bytes([int(msg.msg_type)])
+    out += msg.view_id.to_bytes(8, "little")
+    out += msg.block_num.to_bytes(8, "little")
+    if len(msg.block_hash) != 32:
+        raise ValueError("block hash must be 32 bytes")
+    out += msg.block_hash
+    out += len(msg.sender_pubkeys).to_bytes(4, "little")
+    for pk in msg.sender_pubkeys:
+        if len(pk) != PUBKEY_BYTES:
+            raise ValueError("pubkey must be 48 bytes")
+        out += pk
+    out += len(msg.payload).to_bytes(4, "little") + msg.payload
+    out += len(msg.block).to_bytes(4, "little") + msg.block
+    return bytes(out)
+
+
+def decode_message(data: bytes) -> FBFTMessage:
+    view = memoryview(data)
+    if len(view) < 1 + 8 + 8 + 32 + 4:
+        raise ValueError("message too short")
+    off = 0
+    msg_type = MsgType(view[off]); off += 1
+    view_id = int.from_bytes(view[off:off + 8], "little"); off += 8
+    block_num = int.from_bytes(view[off:off + 8], "little"); off += 8
+    block_hash = bytes(view[off:off + 32]); off += 32
+    n_keys = int.from_bytes(view[off:off + 4], "little"); off += 4
+    if n_keys > 4096:
+        raise ValueError("absurd key count")
+    keys = []
+    for _ in range(n_keys):
+        keys.append(bytes(view[off:off + PUBKEY_BYTES]))
+        off += PUBKEY_BYTES
+    plen = int.from_bytes(view[off:off + 4], "little"); off += 4
+    payload = bytes(view[off:off + plen]); off += plen
+    blen = int.from_bytes(view[off:off + 4], "little"); off += 4
+    block = bytes(view[off:off + blen]); off += blen
+    if off != len(view):
+        raise ValueError("trailing bytes in message")
+    return FBFTMessage(
+        msg_type=msg_type, view_id=view_id, block_num=block_num,
+        block_hash=block_hash, sender_pubkeys=keys, payload=payload,
+        block=block,
+    )

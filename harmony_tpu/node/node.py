@@ -1,0 +1,334 @@
+"""The Node: chain + mempool + FBFT consensus + gossip, wired.
+
+The role of the reference's node/harmony (reference:
+node/harmony/node.go:89-138 Node struct; :613-944 StartPubSub per-topic
+validators; :473-608 validateShardBoundMessage cheap pre-checks;
+consensus wiring in cmd/harmony/main.go:707 — SURVEY.md §2.6 + §3.2).
+
+Design: the Node is an event-pump state machine.  Gossip handlers only
+ENQUEUE (after the cheap ingress filter); ``process_pending`` drains
+the queue through the FBFT handlers — so transports may deliver on any
+thread, reentrancy is impossible, and tests drive rounds
+deterministically by pumping.  ``run_forever`` wraps the pump in a
+thread for live deployments.
+
+Leader rotation: round-robin by view id over the committee (the
+reference's uniform NthNextValidator policy, quorum.go:206-320; its
+stake-weighted rotation variants ride the same hook).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..consensus.fbft import Leader, RoundConfig, Validator
+from ..consensus.messages import (
+    FBFTMessage,
+    MsgType,
+    decode_message,
+    encode_message,
+)
+from ..consensus.quorum import Decider, Policy
+from ..consensus.sender import MessageSender
+from ..core import rawdb
+from ..core.blockchain import ChainError
+from ..multibls import PrivateKeys
+from ..p2p import consensus_topic
+from ..p2p.host import ACCEPT, IGNORE
+from .ingress import (
+    VIEW_ID_WINDOW,
+    IngressContext,
+    MessageCategory,
+    pack_envelope,
+    parse_envelope,
+    validate_consensus_message,
+)
+from .worker import Worker
+
+
+class Node:
+    def __init__(self, registry, keys: PrivateKeys, network: str = "localnet",
+                 policy: Policy = Policy.UNIFORM, roster=None):
+        self.registry = registry
+        self.chain = registry.blockchain
+        self.pool = registry.txpool
+        self.keys = keys
+        self.network = network
+        self.policy = policy
+        self.roster = roster
+        self.worker = Worker(self.chain, self.pool)
+        self.host = registry.host
+        self.topic = consensus_topic(network, self.chain.shard_id)
+        self.sender = MessageSender(self.host, [self.topic])
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self.committed_blocks = 0
+        self._vc = 0  # view changes since last commit
+
+        self.host.add_validator(self.topic, self._gossip_validator)
+        self.host.subscribe(self.topic, self._on_gossip)
+        self._new_round()
+
+    # -- committee / role ---------------------------------------------------
+
+    def committee(self) -> list:
+        """Serialized pubkeys for the CURRENT epoch (the genesis
+        committee until election rotates it — shard/committee)."""
+        return list(self.chain.genesis.committee)
+
+    def leader_key(self, view_id: int) -> bytes:
+        committee = self.committee()
+        return committee[view_id % len(committee)]
+
+    @property
+    def is_leader(self) -> bool:
+        return any(
+            k.pub.bytes == self.leader_key(self.view_id) for k in self.keys
+        )
+
+    # -- round lifecycle ----------------------------------------------------
+
+    def _new_round(self):
+        head = self.chain.current_header()
+        self.block_num = head.block_num + 1
+        # every node derives the same view id from the committed head
+        # plus its local view-change count (reset on commit)
+        self.view_id = head.view_id + 1 + self._vc
+        committee = self.committee()
+        cfg = RoundConfig(
+            committee=committee,
+            block_num=self.block_num,
+            view_id=self.view_id,
+            is_staking=self.chain.config.is_staking(
+                self.chain.epoch_of(self.block_num)
+            ),
+        )
+        decider = Decider(self.policy, committee, self.roster)
+        self.leader = Leader(self.keys, cfg, decider)
+        self.validator = Validator(self.keys, cfg, decider)
+        self._proposed = False
+        self._sent_prepared = False
+        self._sent_committed = False
+        self._pending_block = None  # validator's decoded announce block
+
+    # -- gossip ingress -----------------------------------------------------
+
+    def _gossip_validator(self, payload: bytes, frm: str) -> int:
+        """Cheap pre-checks before any pairing work (reference:
+        node.go:473-608) — run inside the gossip validate step so bad
+        messages are not re-flooded."""
+        try:
+            category, msg_type, body = parse_envelope(payload)
+            if category != MessageCategory.CONSENSUS:
+                return ACCEPT  # not ours to judge
+            msg = decode_message(body)
+        except ValueError:
+            return IGNORE
+        ctx = IngressContext(
+            shard_id=self.chain.shard_id,
+            current_view_id=self.view_id,
+            committee_keys=set(self.committee()),
+            is_leader=self.is_leader,
+        )
+        result = validate_consensus_message(msg, ctx, self.chain.shard_id)
+        return ACCEPT if result.accepted else IGNORE
+
+    def _on_gossip(self, topic: str, payload: bytes, frm: str):
+        self._queue.put(payload)
+
+    def _broadcast(self, msg: FBFTMessage, retry: bool = False):
+        env = pack_envelope(
+            MessageCategory.CONSENSUS, int(msg.msg_type), encode_message(msg)
+        )
+        if retry:
+            self.sender.send_with_retry(msg.block_num, msg.msg_type, env)
+        else:
+            self.sender.send_without_retry(env)
+        return env
+
+    # -- the pump -----------------------------------------------------------
+
+    def start_round_if_leader(self):
+        """Leader proposes + announces (reference: consensus/proposer.go
+        WaitForConsensusReadyV2 -> ProposeNewBlock -> announce)."""
+        if not self.is_leader or self._proposed:
+            return None
+        block = self.worker.propose_block(view_id=self.view_id)
+        block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
+        self._pending_block = block
+        self._proposed = True
+        msg = self.leader.announce(block.hash(), block_bytes)
+        self._broadcast(msg, retry=True)
+        # a leader whose own keys already meet quorum (single-operator
+        # committee) must advance without waiting for external votes
+        self._leader_advance()
+        return block
+
+    def process_pending(self, max_msgs: int = 0) -> int:
+        """Drain queued gossip through the FBFT handlers; returns the
+        number of messages processed."""
+        n = 0
+        while not self._stop.is_set():
+            try:
+                payload = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._handle(payload)
+            n += 1
+            if max_msgs and n >= max_msgs:
+                break
+        return n
+
+    def _handle(self, payload: bytes):
+        try:
+            category, _, body = parse_envelope(payload)
+            if category != MessageCategory.CONSENSUS:
+                return
+            msg = decode_message(body)
+        except ValueError:
+            return
+        if msg.block_num != self.block_num:
+            return  # stale/future round (sync handles catch-up)
+        handler = {
+            MsgType.ANNOUNCE: self._on_announce,
+            MsgType.PREPARE: self._on_prepare,
+            MsgType.PREPARED: self._on_prepared,
+            MsgType.COMMIT: self._on_commit,
+            MsgType.COMMITTED: self._on_committed,
+        }.get(msg.msg_type)
+        if handler is not None:
+            handler(msg)
+
+    # -- FBFT phase handlers ------------------------------------------------
+
+    def _validate_proposed_block(self, block_bytes: bytes):
+        """Decode + dry-run the proposal (reference: validator.go:83-143
+        validateNewBlock: full execution before committing to it)."""
+        try:
+            block = rawdb.decode_block(block_bytes)
+        except (ValueError, IndexError):
+            return None
+        header = block.header
+        head = self.chain.current_header()
+        if header.block_num != head.block_num + 1:
+            return None
+        if header.parent_hash != head.hash():
+            return None
+        if block.tx_root(self.chain.config.chain_id) != header.tx_root:
+            return None
+        try:
+            state = self.chain.state().copy()
+            self.chain.processor.process(
+                state, block, header.epoch
+            )
+            if self.chain.is_epoch_boundary(header.block_num):
+                self.chain.processor.payout_undelegations(
+                    state, header.epoch
+                )
+            if state.root() != header.root:
+                return None
+        except ValueError:
+            return None
+        return block
+
+    def _on_announce(self, msg: FBFTMessage):
+        if self.is_leader:
+            return
+        if msg.sender_pubkeys and msg.sender_pubkeys[0] != self.leader_key(
+            msg.view_id
+        ):
+            return  # announce not from the round's leader
+        block = self._validate_proposed_block(msg.block)
+        if block is None:
+            return
+        self._pending_block = block
+        vote = self.validator.on_announce(msg)
+        self._broadcast(vote)
+
+    def _leader_advance(self):
+        """Emit PREPARED/COMMITTED the moment their quorum holds for the
+        ANNOUNCED block (reference: threshold.go:14-69 + finalCommit)."""
+        block_hash = self.leader.current_block_hash
+        if block_hash is None:
+            return
+        if not self._sent_prepared:
+            prepared = self.leader.try_prepared(block_hash)
+            if prepared is not None:
+                self._sent_prepared = True
+                self._broadcast(prepared, retry=True)
+                # leader self-commits with its own keys
+                # (reference: threshold.go:53-69)
+                commit_vote = self.validator.on_prepared(prepared)
+                if commit_vote is not None:
+                    self.leader.on_commit(commit_vote)
+        if self._sent_prepared and not self._sent_committed:
+            committed = self.leader.try_committed(block_hash)
+            if committed is not None:
+                self._sent_committed = True
+                self._broadcast(committed, retry=True)
+                self._commit_block(committed)
+
+    def _on_prepare(self, msg: FBFTMessage):
+        if not self.is_leader:
+            return
+        self.leader.on_prepare(msg)
+        self._leader_advance()
+
+    def _on_prepared(self, msg: FBFTMessage):
+        if self.is_leader:
+            return
+        vote = self.validator.on_prepared(msg)
+        if vote is not None:
+            self._broadcast(vote)
+
+    def _on_commit(self, msg: FBFTMessage):
+        if not self.is_leader:
+            return
+        self.leader.on_commit(msg)
+        self._leader_advance()
+
+    def _on_committed(self, msg: FBFTMessage):
+        if self.is_leader:
+            return
+        if not self.validator.on_committed(msg):
+            return
+        self._commit_block(msg)
+
+    def _commit_block(self, msg: FBFTMessage):
+        """Insert the round's block with its quorum proof (reference:
+        consensus_v2.go:702 commitBlock -> InsertChain)."""
+        block = self._pending_block
+        if block is None or block.hash() != msg.block_hash:
+            return
+        try:
+            self.chain.insert_chain(
+                [block], commit_sigs=[msg.payload],
+                verify_seals=self.chain.engine is not None,
+            )
+        except ChainError:
+            return
+        if self.pool is not None:
+            self.pool.drop_applied()
+        self.sender.stop_retry(block.block_num)
+        self.committed_blocks += 1
+        self._vc = 0
+        self._sent_prepared = False
+        self._sent_committed = False
+        self._new_round()
+
+    # -- live mode ----------------------------------------------------------
+
+    def run_forever(self, poll_interval: float = 0.01):
+        def loop():
+            while not self._stop.is_set():
+                self.start_round_if_leader()
+                if not self.process_pending():
+                    self._stop.wait(poll_interval)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,29 @@
+"""Host-side networking: gossip, discovery-lite, gating, sync streams.
+
+The role of the reference's libp2p stack (reference: p2p/host.go:59-80
+Host interface, gossipsub topics, p2p/gating + p2p/security peer
+control, p2p/stream request/response sync — SURVEY.md §2.5), rebuilt
+on the standard library: the WAN gossip layer is host CPU work by
+nature (SURVEY.md §2.5 "TPU-relevant note") — the TPU boundary is the
+crypto batch, not the socket.
+
+- groups:   topic naming per (network, shard, purpose);
+- host:     Host API with an in-process hub (tests/localnet-in-one-
+            process) and a TCP flood-gossip implementation;
+- gating:   connection limits and blocklists;
+- stream:   length-prefixed request/response sync protocol.
+"""
+
+from .gating import Gater
+from .groups import GroupID, consensus_topic, node_topic
+from .host import Host, InProcessNetwork, TCPHost
+
+__all__ = [
+    "Gater",
+    "GroupID",
+    "Host",
+    "InProcessNetwork",
+    "TCPHost",
+    "consensus_topic",
+    "node_topic",
+]

@@ -1,0 +1,314 @@
+"""Gossip hosts: publish/subscribe over topics.
+
+The role of the reference's p2p.Host (reference: p2p/host.go:59-80 —
+AddStreamProtocol, SendMessageToGroups, subscription with per-topic
+validators; gossipsub under the hood).  Two implementations:
+
+- ``InProcessNetwork`` + its hosts — a shared hub delivering messages
+  synchronously between hosts in one process: the localnet-in-one-
+  process test pattern (the reference's consensus tests likewise run
+  real hosts on localhost — SURVEY.md §4).
+- ``TCPHost`` — flood gossip over TCP with message-id dedup: each
+  frame is [u32 len][u8 kind][payload]; PUBLISH payloads carry
+  (topic, msg-id, body) and are re-flooded to every peer except the
+  arrival peer until the id is seen.  Validators run before re-flood,
+  mirroring gossipsub's validate-then-propagate contract
+  (p2p/host.go:92-97 registers 8192-concurrency validators).
+
+Message size cap mirrors the reference's 2 MB (p2p/host.go:98-99).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+
+from ..ref.keccak import keccak256
+from .gating import Gater
+
+MAX_MESSAGE_BYTES = 2 * 1024 * 1024  # reference: p2p/host.go:98-99
+_FRAME = struct.Struct("<IB")
+_KIND_PUBLISH = 1
+_KIND_HELLO = 2
+
+# validator verdicts (gossipsub semantics)
+ACCEPT = 0
+REJECT = 1   # drop and do not propagate
+IGNORE = 2   # drop silently (still counts as seen)
+
+
+class _SeenCache:
+    """Bounded message-id dedup."""
+
+    def __init__(self, cap: int = 65536):
+        self._d: OrderedDict[bytes, bool] = OrderedDict()
+        self.cap = cap
+        self._lock = threading.Lock()
+
+    def seen(self, mid: bytes) -> bool:
+        """True if already present; marks it present."""
+        with self._lock:
+            if mid in self._d:
+                self._d.move_to_end(mid)
+                return True
+            self._d[mid] = True
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+            return False
+
+
+class Host:
+    """Common topic/validator bookkeeping for both transports."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._handlers: dict[str, list] = {}
+        self._validators: dict[str, list] = {}
+        self._seen = _SeenCache()
+        self._lock = threading.Lock()
+
+    # -- subscription API (reference: host.go:66-71) ------------------------
+
+    def subscribe(self, topic: str, handler):
+        """handler(topic, payload, from_name)."""
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def add_validator(self, topic: str, validator):
+        """validator(payload, from_name) -> ACCEPT/REJECT/IGNORE."""
+        with self._lock:
+            self._validators.setdefault(topic, []).append(validator)
+
+    def topics(self) -> list:
+        with self._lock:
+            return sorted(set(self._handlers) | set(self._validators))
+
+    def _validate(self, topic: str, payload: bytes, frm: str) -> int:
+        with self._lock:
+            validators = list(self._validators.get(topic, ()))
+        for v in validators:
+            verdict = v(payload, frm)
+            if verdict != ACCEPT:
+                return verdict
+        return ACCEPT
+
+    def _deliver(self, topic: str, payload: bytes, frm: str):
+        with self._lock:
+            handlers = list(self._handlers.get(topic, ()))
+        for h in handlers:
+            h(topic, payload, frm)
+
+    # -- to implement -------------------------------------------------------
+
+    def publish(self, topic: str, payload: bytes):
+        raise NotImplementedError
+
+    def publish_to_groups(self, topics: list, payload: bytes):
+        """reference: p2p/host.go:73 SendMessageToGroups."""
+        for t in topics:
+            self.publish(t, payload)
+
+    def close(self):
+        pass
+
+
+class InProcessNetwork:
+    """Hub connecting InProcess hosts (deterministic, synchronous)."""
+
+    def __init__(self):
+        self._hosts: list = []
+        self._lock = threading.Lock()
+        self.partitioned: set = set()  # names cut off (failure injection)
+
+    def host(self, name: str) -> "_InProcessHost":
+        h = _InProcessHost(name, self)
+        with self._lock:
+            self._hosts.append(h)
+        return h
+
+    def route(self, topic: str, payload: bytes, frm: str):
+        if len(payload) > MAX_MESSAGE_BYTES:
+            raise ValueError("message exceeds 2 MB cap")
+        if frm in self.partitioned:
+            return
+        with self._lock:
+            hosts = list(self._hosts)
+        for h in hosts:
+            if h.name == frm or h.name in self.partitioned:
+                continue
+            mid = keccak256(topic.encode() + payload)
+            if h._seen.seen(mid):
+                continue
+            if h._validate(topic, payload, frm) == ACCEPT:
+                h._deliver(topic, payload, frm)
+
+
+class _InProcessHost(Host):
+    def __init__(self, name: str, net: InProcessNetwork):
+        super().__init__(name)
+        self._net = net
+
+    def publish(self, topic: str, payload: bytes):
+        self._net.route(topic, payload, self.name)
+
+
+class TCPHost(Host):
+    """Flood gossip over TCP.
+
+    Peers are symmetric: either side connects (``connect``), both ends
+    then exchange HELLO (name) and flood PUBLISH frames.  Delivery and
+    re-flood run on a per-peer reader thread.
+    """
+
+    def __init__(self, name: str = "", listen_port: int = 0,
+                 gater: Gater | None = None):
+        super().__init__(name)
+        self.gater = gater or Gater()
+        self._peers: dict[object, str] = {}  # socket -> peer name
+        self._peer_lock = threading.Lock()
+        self._closing = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", listen_port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- wire ---------------------------------------------------------------
+
+    @staticmethod
+    def _send_frame(sock, kind: int, payload: bytes):
+        sock.sendall(_FRAME.pack(len(payload), kind) + payload)
+
+    @staticmethod
+    def _recv_exact(sock, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                sock, addr = self._srv.accept()
+            except OSError:
+                return
+            if not self.gater.allow(addr[0]):
+                sock.close()
+                continue
+            threading.Thread(
+                target=self._peer_loop, args=(sock, addr[0]), daemon=True
+            ).start()
+
+    def connect(self, port: int, host: str = "127.0.0.1"):
+        sock = socket.create_connection((host, port), timeout=10)
+        if not self.gater.allow(host):
+            sock.close()
+            raise ConnectionError("gater refused outbound peer")
+        threading.Thread(
+            target=self._peer_loop, args=(sock, host), daemon=True
+        ).start()
+
+    def _peer_loop(self, sock, ip: str):
+        try:
+            self._send_frame(sock, _KIND_HELLO, self.name.encode())
+            hdr = self._recv_exact(sock, _FRAME.size)
+            if hdr is None:
+                return
+            ln, kind = _FRAME.unpack(hdr)
+            if kind != _KIND_HELLO or ln > 256:
+                return
+            peer_name = (self._recv_exact(sock, ln) or b"").decode()
+            with self._peer_lock:
+                self._peers[sock] = peer_name
+            while not self._closing:
+                hdr = self._recv_exact(sock, _FRAME.size)
+                if hdr is None:
+                    return
+                ln, kind = _FRAME.unpack(hdr)
+                if ln > MAX_MESSAGE_BYTES + 4096:
+                    return  # oversized: drop the peer
+                body = self._recv_exact(sock, ln)
+                if body is None:
+                    return
+                if kind == _KIND_PUBLISH:
+                    self._on_publish(body, sock, peer_name)
+        except OSError:
+            pass
+        finally:
+            with self._peer_lock:
+                self._peers.pop(sock, None)
+            self.gater.release(ip)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- gossip -------------------------------------------------------------
+
+    @staticmethod
+    def _pack_publish(topic: str, payload: bytes) -> bytes:
+        t = topic.encode()
+        return bytes([len(t)]) + t + payload
+
+    def _on_publish(self, body: bytes, src_sock, frm: str):
+        tlen = body[0]
+        topic = body[1:1 + tlen].decode()
+        payload = body[1 + tlen:]
+        mid = keccak256(body)
+        if self._seen.seen(mid):
+            return
+        verdict = self._validate(topic, payload, frm)
+        if verdict != ACCEPT:
+            return
+        if topic in self._handlers:
+            self._deliver(topic, payload, frm)
+        self._flood(body, exclude=src_sock)
+
+    def _flood(self, body: bytes, exclude=None):
+        with self._peer_lock:
+            socks = [s for s in self._peers if s is not exclude]
+        for s in socks:
+            try:
+                self._send_frame(s, _KIND_PUBLISH, body)
+            except OSError:
+                pass
+
+    def publish(self, topic: str, payload: bytes):
+        if len(payload) > MAX_MESSAGE_BYTES:
+            raise ValueError("message exceeds 2 MB cap")
+        body = self._pack_publish(topic, payload)
+        self._seen.seen(keccak256(body))  # don't re-deliver to self
+        self._flood(body)
+
+    def peer_count(self) -> int:
+        with self._peer_lock:
+            return len(self._peers)
+
+    def wait_for_peers(self, n: int, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.peer_count() >= n:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def close(self):
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            socks = list(self._peers)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass

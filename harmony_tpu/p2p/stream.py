@@ -1,0 +1,206 @@
+"""Sync streams: request/response block download protocol.
+
+The role of the reference's p2p/stream framework (reference:
+p2p/stream/protocols/sync/protocol.go:86-177 — protocol id
+hmy/sync/<network>/<shard>/<version>; client.go GetBlocksByNumber /
+GetBlockHashes; streammanager pooling + requestmanager matching —
+SURVEY.md §2.5).  Here a stream is one TCP connection per peer pair;
+requests carry ids so responses match out-of-order; the server side
+answers from a Blockchain.
+
+Wire: [u32 len][u8 kind][u64 req_id][payload]; kinds are REQ/RESP with
+a method byte leading the payload.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..core import rawdb
+from ..core.types import _enc_bytes, _enc_int
+from ..core.types import Reader as _Reader
+
+PROTOCOL_VERSION = 1
+_HDR = struct.Struct("<IBQ")
+_REQ, _RESP = 1, 2
+
+METHOD_BLOCK_HASHES = 1    # [u64 start][u32 count] -> [hash...]
+METHOD_BLOCKS_BY_NUM = 2   # [u64 start][u32 count] -> [block blob...]
+METHOD_HEAD = 3            # [] -> [u64 head][32B hash]
+MAX_BLOCKS_PER_REQUEST = 128  # server-side clamp
+
+
+def protocol_id(network: str, shard_id: int) -> str:
+    """reference: protocol.go:86 — hmy/sync/<net>/<shard>/<version>."""
+    return f"harmony-tpu/sync/{network}/{shard_id}/{PROTOCOL_VERSION}"
+
+
+class SyncServer:
+    """Serves a chain over the stream protocol."""
+
+    def __init__(self, chain, listen_port: int = 0):
+        self.chain = chain
+        self._closing = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", listen_port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(sock,), daemon=True
+            ).start()
+
+    def _serve_conn(self, sock):
+        try:
+            while not self._closing:
+                hdr = _recv_exact(sock, _HDR.size)
+                if hdr is None:
+                    return
+                ln, kind, req_id = _HDR.unpack(hdr)
+                body = _recv_exact(sock, ln)
+                if body is None or kind != _REQ:
+                    return
+                resp = self._handle(body)
+                sock.sendall(_HDR.pack(len(resp), _RESP, req_id) + resp)
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, body: bytes) -> bytes:
+        method = body[0]
+        r = _Reader(body[1:])
+        if method == METHOD_HEAD:
+            head = self.chain.head_number
+            return (
+                head.to_bytes(8, "little")
+                + self.chain.current_header().hash()
+            )
+        start = r.int_()
+        count = min(r.int_(4), MAX_BLOCKS_PER_REQUEST)
+        if method == METHOD_BLOCK_HASHES:
+            out = bytearray()
+            for num in range(start, start + count):
+                h = rawdb.read_canonical_hash(self.chain.db, num)
+                if h is None:
+                    break
+                out += h
+            return bytes(out)
+        if method == METHOD_BLOCKS_BY_NUM:
+            out = bytearray()
+            blobs = []
+            for num in range(start, start + count):
+                block = self.chain.block_by_number(num)
+                if block is None:
+                    break
+                blob = (
+                    _enc_bytes(rawdb.encode_header(block.header))
+                    + _enc_bytes(
+                        rawdb.encode_body(block, self.chain.config.chain_id)
+                    )
+                    + _enc_bytes(self.chain.read_commit_sig(num) or b"")
+                )
+                blobs.append(blob)
+            out += _enc_int(len(blobs), 4)
+            for blob in blobs:
+                out += _enc_bytes(blob)
+            return bytes(out)
+        return b""
+
+    def close(self):
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class SyncClient:
+    """One peer's sync stream (reference: sync/client.go)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def _call(self, payload: bytes) -> bytes:
+        with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+            self._sock.sendall(
+                _HDR.pack(len(payload), _REQ, req_id) + payload
+            )
+            while True:
+                hdr = _recv_exact(self._sock, _HDR.size)
+                if hdr is None:
+                    raise ConnectionError("sync stream closed")
+                ln, kind, rid = _HDR.unpack(hdr)
+                body = _recv_exact(self._sock, ln)
+                if body is None:
+                    raise ConnectionError("sync stream closed")
+                if kind == _RESP and rid == req_id:
+                    return body
+
+    def get_head(self) -> tuple[int, bytes]:
+        resp = self._call(bytes([METHOD_HEAD]))
+        return int.from_bytes(resp[:8], "little"), resp[8:40]
+
+    def get_block_hashes(self, start: int, count: int) -> list:
+        resp = self._call(
+            bytes([METHOD_BLOCK_HASHES])
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+        )
+        return [resp[i:i + 32] for i in range(0, len(resp), 32)]
+
+    def get_blocks_by_number(self, start: int, count: int) -> list:
+        """[(Block, commit_sig_or_None)] — the replay feed."""
+        resp = self._call(
+            bytes([METHOD_BLOCKS_BY_NUM])
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+        )
+        r = _Reader(resp)
+        out = []
+        for _ in range(r.int_(4)):
+            item = _Reader(r.bytes_())
+            header = rawdb.decode_header(item.bytes_())
+            txs, stxs, cxs, order = rawdb.decode_body(item.bytes_())
+            sig = item.bytes_()
+            from ..core.types import Block
+
+            out.append(
+                (Block(header, txs, stxs, cxs, order), sig or None)
+            )
+        return out
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(sock, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

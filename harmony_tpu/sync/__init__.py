@@ -1,0 +1,5 @@
+"""Chain synchronization over sync streams."""
+
+from .staged import Downloader, SyncResult
+
+__all__ = ["Downloader", "SyncResult"]

@@ -1,27 +1,37 @@
-"""Test configuration: CPU-only JAX with a persistent compile cache.
+"""Test configuration: CPU-only JAX with a READ-ONLY compile cache.
 
 The axon sitecustomize force-selects jax_platforms="axon,cpu" via
 jax.config.update at interpreter start, which silently overrides the
 JAX_PLATFORMS env var — so the env var alone is NOT enough; we must
 counter-update the config before any backend initializes.
 
-Multi-chip sharding is validated in a SEPARATE process
-(tests/test_parallel.py subprocesses __graft_entry__.dryrun_multichip
-with xla_force_host_platform_device_count): executables compiled under
-forced multi-device CPU topologies segfault XLA's persistent-cache
-serializer on this image (observed twice in put_executable_and_time), so
-the in-process suite stays single-device where cache writes are stable
-and warm across runs.
+The persistent cache is READ-only here: XLA's cache serializer
+(put_executable_and_time) segfaults intermittently on this image —
+first observed under forced multi-device CPU topologies, then
+(2026-07-29 02:16) on a plain single-device suite run.  Reads are safe
+and serve the warm cache built by bench/entry runs; writes are gated
+off by an unreachable min-compile-time.  Multi-chip sharding is
+validated in a SEPARATE process (tests/test_parallel.py subprocesses
+__graft_entry__.dryrun_multichip).
 """
 
 import os
+
+# XLA:CPU's parallel LLVM codegen (default split 32 threads) has
+# intermittently segfaulted backend_compile_and_load on this 1-core
+# image (2026-07-29, twice); serialize codegen before jax initializes.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "parallel_codegen" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1"
+    ).strip()
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 # Big-integer field arithmetic compiles slowly on XLA:CPU (~7 ms/HLO line);
-# cache compiled executables across test runs and sessions.
+# reuse executables cached by bench/entry runs (reads only — see above).
 _CACHE = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10**9)

@@ -43,13 +43,16 @@ def test_full_round(network):
     announce = leader.announce(block_hash, block)
     assert announce.msg_type == MsgType.ANNOUNCE
 
-    # validators sign prepare votes; leader verifies each (hot loop)
-    prepares = [v.on_announce(announce) for v in validators]
-    # leader self-votes with its own keys
+    # announce itself cast the leader's own prepare vote (leader.go:20)
+    assert leader.decider.count(FB.Phase.PREPARE) == len(leader.keys)
+    # a re-sent self-vote is a duplicate and must be rejected
     self_prep = FB.Validator(leader.keys, cfg, leader.decider).on_announce(
         announce
     )
-    assert leader.on_prepare(self_prep)
+    assert not leader.on_prepare(self_prep)
+
+    # validators sign prepare votes; leader verifies each (hot loop)
+    prepares = [v.on_announce(announce) for v in validators]
     for p in prepares:
         assert leader.on_prepare(p)
 

@@ -1,0 +1,246 @@
+"""Networking + node integration: gossip hosts, sync streams, staged
+download, and a full in-process FBFT localnet committing blocks (the
+reference's localnet test tier — SURVEY.md §4 — in one process)."""
+
+import threading
+import time
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.core.types import Transaction
+from harmony_tpu.crypto_ecdsa import ECDSAKey
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.node.node import Node
+from harmony_tpu.node.registry import Registry
+from harmony_tpu.node.services import Manager, Service, ServiceType
+from harmony_tpu.node.worker import Worker
+from harmony_tpu.p2p import InProcessNetwork, TCPHost, consensus_topic
+from harmony_tpu.p2p.gating import Gater
+from harmony_tpu.p2p.host import ACCEPT, IGNORE
+from harmony_tpu.p2p.stream import SyncClient, SyncServer
+from harmony_tpu.sync import Downloader
+
+CHAIN_ID = 2
+
+
+# -- hosts ------------------------------------------------------------------
+
+def test_inprocess_gossip_validate_and_deliver():
+    net = InProcessNetwork()
+    a, b, c = net.host("a"), net.host("b"), net.host("c")
+    got = []
+    b.subscribe("t", lambda t, p, f: got.append((t, p, f)))
+    c.add_validator("t", lambda p, f: ACCEPT if p != b"bad" else IGNORE)
+    got_c = []
+    c.subscribe("t", lambda t, p, f: got_c.append(p))
+    a.publish("t", b"hello")
+    a.publish("t", b"bad")
+    assert got == [("t", b"hello", "a"), ("t", b"bad", "a")]
+    assert got_c == [b"hello"]  # validator filtered "bad"
+
+
+def test_tcp_gossip_flood_and_dedup():
+    h1 = TCPHost("n1")
+    h2 = TCPHost("n2")
+    h3 = TCPHost("n3")
+    try:
+        # line topology: n1 - n2 - n3; flood must transit n2
+        h2.connect(h1.port)
+        h3.connect(h2.port)
+        assert h1.wait_for_peers(1) and h3.wait_for_peers(1)
+        assert h2.wait_for_peers(2)
+        got1, got3 = [], []
+        h1.subscribe("x", lambda t, p, f: got1.append(p))
+        h3.subscribe("x", lambda t, p, f: got3.append(p))
+        h1.publish("x", b"m1")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not got3:
+            time.sleep(0.01)
+        assert got3 == [b"m1"]
+        assert got1 == []  # no self-delivery, no echo back
+    finally:
+        h1.close(), h2.close(), h3.close()
+
+
+def test_gater_limits():
+    g = Gater(max_peers=2, max_per_ip=1)
+    assert g.allow("10.0.0.1")
+    assert not g.allow("10.0.0.1")  # per-ip
+    assert g.allow("10.0.0.2")
+    assert not g.allow("10.0.0.3")  # total
+    g.release("10.0.0.1")
+    g.ban("10.0.0.3")
+    assert not g.allow("10.0.0.3")  # banned even though slot free
+    assert g.allow("10.0.0.1")
+
+
+# -- sync streams -----------------------------------------------------------
+
+def _chain_with_blocks(n=3):
+    genesis, keys, bls_keys = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    worker = Worker(chain, pool)
+    to = b"\x05" * 20
+    for i in range(n):
+        tx = Transaction(
+            nonce=i, gas_price=1, gas_limit=25_000, shard_id=0,
+            to_shard=0, to=to, value=100 + i,
+        ).sign(keys[0], CHAIN_ID)
+        pool.add(tx)
+        block = worker.propose_block(view_id=i + 1)
+        chain.insert_chain([block], verify_seals=False)
+        chain.write_commit_sig(block.block_num, b"\x01" * 96 + b"\x0f")
+        pool.drop_applied()
+    return chain, genesis
+
+
+def test_sync_stream_and_staged_download():
+    serving, genesis = _chain_with_blocks(5)
+    srv = SyncServer(serving)
+    try:
+        cli = SyncClient(srv.port)
+        head, head_hash = cli.get_head()
+        assert head == 5
+        assert head_hash == serving.current_header().hash()
+        hashes = cli.get_block_hashes(1, 5)
+        assert len(hashes) == 5
+        blocks = cli.get_blocks_by_number(1, 2)
+        assert [b.block_num for b, _ in blocks] == [1, 2]
+        assert blocks[0][0].hash() == hashes[0]
+        assert blocks[0][1] is not None  # commit sig travels along
+
+        # fresh chain catches up via the staged downloader
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(fresh, [SyncClient(srv.port)], batch=2,
+                        verify_seals=False)
+        res = dl.sync_once()
+        assert res.inserted == 5 and not res.errors
+        assert fresh.head_number == 5
+        assert fresh.current_header().hash() == head_hash
+        assert fresh.state().root() == serving.state().root()
+    finally:
+        srv.close()
+
+
+# -- service manager --------------------------------------------------------
+
+class _SpySvc(Service):
+    def __init__(self, log, name, fail=False):
+        self.log, self.name, self.fail = log, name, fail
+
+    def start(self):
+        if self.fail:
+            raise RuntimeError("boom")
+        self.log.append(("start", self.name))
+
+    def stop(self):
+        self.log.append(("stop", self.name))
+
+
+def test_service_manager_order_and_rollback():
+    log = []
+    m = Manager()
+    m.register(ServiceType.CONSENSUS, _SpySvc(log, "consensus"))
+    m.register(ServiceType.SYNCHRONIZE, _SpySvc(log, "sync"))
+    m.start_services()
+    m.stop_services()
+    assert log == [
+        ("start", "consensus"), ("start", "sync"),
+        ("stop", "sync"), ("stop", "consensus"),
+    ]
+    log.clear()
+    m2 = Manager()
+    m2.register(ServiceType.CONSENSUS, _SpySvc(log, "a"))
+    m2.register(ServiceType.PROMETHEUS, _SpySvc(log, "b", fail=True))
+    with pytest.raises(RuntimeError):
+        m2.start_services()
+    assert log == [("start", "a"), ("stop", "a")]  # rollback
+
+
+# -- the localnet: N nodes committing blocks over gossip --------------------
+
+def _make_localnet(n_nodes=4):
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=n_nodes)
+    net = InProcessNetwork()
+    nodes = []
+    for i in range(n_nodes):
+        chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        reg = Registry(
+            blockchain=chain, txpool=pool, host=net.host(f"node{i}")
+        )
+        node = Node(reg, PrivateKeys.from_keys([bls_keys[i]]))
+        nodes.append(node)
+    return nodes, ecdsa_keys, net
+
+
+def _pump(nodes, rounds=50):
+    for _ in range(rounds):
+        if not any(n.process_pending() for n in nodes):
+            break
+
+
+def test_localnet_commits_blocks_over_gossip():
+    nodes, ecdsa_keys, net = _make_localnet(4)
+    leaders = [n for n in nodes if n.is_leader]
+    assert len(leaders) == 1
+
+    # round 1: empty block
+    leaders[0].start_round_if_leader()
+    _pump(nodes)
+    assert all(n.chain.head_number == 1 for n in nodes)
+    assert all(n.committed_blocks == 1 for n in nodes)
+
+    # round 2: a transfer reaches every replica's state
+    to = b"\x0a" * 20
+    tx = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=0,
+        to=to, value=777,
+    ).sign(ecdsa_keys[0], CHAIN_ID)
+    leaders2 = [n for n in nodes if n.is_leader]
+    assert len(leaders2) == 1
+    # leader rotated (round-robin by view id)
+    leaders2[0].pool.add(tx)
+    leaders2[0].start_round_if_leader()
+    _pump(nodes)
+    assert all(n.chain.head_number == 2 for n in nodes)
+    assert all(n.chain.state().balance(to) == 777 for n in nodes)
+    # every replica stored the quorum proof for the committed block
+    assert all(n.chain.read_commit_sig(2) is not None for n in nodes)
+
+
+def test_localnet_tolerates_partitioned_validator():
+    nodes, _, net = _make_localnet(4)
+    # cut one NON-leader node off; 3 of 4 still exceeds 2/3+1 quorum
+    victim = next(n for n in nodes if not n.is_leader)
+    net.partitioned.add(victim.host.name)
+    leader = next(n for n in nodes if n.is_leader)
+    leader.start_round_if_leader()
+    _pump(nodes)
+    live = [n for n in nodes if n is not victim]
+    assert all(n.chain.head_number == 1 for n in live)
+    assert victim.chain.head_number == 0
+
+
+def test_single_node_committee_self_quorum():
+    """A committee whose leader alone meets quorum must produce blocks
+    without any external votes (the announce-time self-vote plus
+    leader self-commit drain through _leader_advance)."""
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=1)
+    net = InProcessNetwork()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    reg = Registry(blockchain=chain, txpool=pool, host=net.host("solo"))
+    node = Node(reg, PrivateKeys.from_keys(bls_keys))
+    assert node.is_leader
+    node.start_round_if_leader()
+    assert node.chain.head_number == 1
+    node.start_round_if_leader()
+    assert node.chain.head_number == 2
+    assert node.chain.read_commit_sig(1) is not None

@@ -1,0 +1,67 @@
+"""Precompile the pinned device-program shapes into .jax_cache.
+
+The test suite runs with a READ-ONLY compile cache (XLA's cache/compile
+path has segfaulted intermittently on this image — tests/conftest.py);
+this tool, run manually/rarely, compiles every pinned batch shape the
+framework dispatches (chain.engine.VERIFY_BUCKETS) with writes ENABLED
+so test/replay runs are pure cache hits.
+
+Usage: python tools/warm_cache.py [cpu|tpu]
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    platform = sys.argv[1] if len(sys.argv) > 1 else "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "parallel_codegen" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_parallel_codegen_split_count=1"
+        ).strip()
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from harmony_tpu import bls as B
+    from harmony_tpu.chain.engine import VERIFY_BUCKETS
+    from harmony_tpu.ops import bls as OB
+    from harmony_tpu.ops import interop as I
+    from harmony_tpu.ref.hash_to_curve import hash_to_g2
+
+    key = B.PrivateKey.generate(b"warm-cache")
+    h = hash_to_g2(b"warm-cache-msg")
+    sig = key.sign_hash(b"warm-cache-msg-hash-32-bytes-pad")
+    pk1 = np.asarray(I.g1_batch_affine([key.pub.point]))
+    h1 = np.asarray(I.g2_batch_affine([h]))
+    sg1 = np.asarray(I.g2_batch_affine([sig.point]))
+
+    for bucket in VERIFY_BUCKETS:
+        t0 = time.time()
+        pk = jnp.asarray(np.repeat(pk1, bucket, axis=0))
+        hh = jnp.asarray(np.repeat(h1, bucket, axis=0))
+        sg = jnp.asarray(np.repeat(sg1, bucket, axis=0))
+        OB.verify(pk, hh, sg).block_until_ready()
+        print(f"verify[B={bucket}]: compiled+cached in "
+              f"{time.time() - t0:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
