@@ -24,7 +24,13 @@ from ..ref import curve as RC
 def bits_from_bytes(bitmap: bytes, n: int):
     """Unpack a little-endian participation bitmap to a 0/1 list — THE
     bit-order convention of the whole protocol (bit i = bit i&7 of byte
-    i>>3; reference: crypto/bls/mask.go:112-120)."""
+    i>>3; reference: crypto/bls/mask.go:112-120).  A bitmap too short
+    for n raises ValueError (never IndexError — callers catch
+    ValueError on untrusted input)."""
+    if len(bitmap) < (n + 7) >> 3:
+        raise ValueError(
+            f"bitmap of {len(bitmap)} bytes cannot cover {n} bits"
+        )
     return [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]
 
 

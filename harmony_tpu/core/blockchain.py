@@ -30,16 +30,20 @@ class ChainError(ValueError):
 
 class Blockchain:
     def __init__(self, db, genesis: Genesis, engine=None,
-                 blocks_per_epoch: int = 32768):
+                 blocks_per_epoch: int = 32768, finalizer=None):
         """engine: chain.engine.Engine or None (no seal checks — tests
-        and block production before wiring consensus)."""
+        and block production before wiring consensus).  finalizer:
+        chain.finalize.Finalizer or None (no rewards/election — the
+        pre-staking chain shape)."""
         self.db = db
         self.genesis = genesis
         self.config = genesis.config
         self.shard_id = genesis.shard_id
         self.engine = engine
+        self.finalizer = finalizer
         self.blocks_per_epoch = blocks_per_epoch
         self.processor = StateProcessor(self.config.chain_id, self.shard_id)
+        self._committee_cache: dict[int, list] = {}
         head = rawdb.read_head_number(db)
         if head is None:
             self._init_genesis()
@@ -102,6 +106,32 @@ class Blockchain:
     def is_epoch_boundary(self, num: int) -> bool:
         return num % self.blocks_per_epoch == 0 and num > 0
 
+    def is_election_block(self, num: int) -> bool:
+        """Last block of its epoch: the committee-selection point
+        (reference: engine.go:412 IsCommitteeSelectionBlock — the
+        block before the epoch turns)."""
+        return (num + 1) % self.blocks_per_epoch == 0
+
+    def committee_for_epoch(self, epoch: int) -> list:
+        """Serialized BLS pubkeys: the elected shard state if one was
+        persisted for this epoch, else the genesis committee.  Cached —
+        this sits on the gossip ingress hot path; the cache entry is
+        dropped when an election writes that epoch's shard state."""
+        cached = self._committee_cache.get(epoch)
+        if cached is not None:
+            return list(cached)
+        keys = list(self.genesis.committee)
+        state = rawdb.read_shard_state(self.db, epoch)
+        if state is not None:
+            com = state.find_committee(self.shard_id)
+            if com is not None and com.slots:
+                keys = com.bls_pubkeys()
+        self._committee_cache[epoch] = keys
+        return list(keys)
+
+    def shard_state_for_epoch(self, epoch: int):
+        return rawdb.read_shard_state(self.db, epoch)
+
     def read_commit_sig(self, num: int) -> bytes | None:
         return rawdb.read_commit_sig(self.db, num)
 
@@ -128,15 +158,41 @@ class Blockchain:
         if block.tx_root(self.config.chain_id) != h.tx_root:
             raise ChainError("tx root does not commit to the body")
 
-    def _execute(self, block: Block) -> tuple[StateDB, object]:
+    def post_process(self, state, block_num: int, epoch: int,
+                     prev_bitmap: bytes | None):
+        """Everything after tx execution that feeds the sealed state
+        root: rewards + availability (per block), undelegation payouts
+        + EPoS status + election (at the boundary).  Shared verbatim by
+        the proposer (worker) and replay so roots agree.  Returns the
+        elected shard state at election blocks (caller persists on
+        insert), else None."""
+        if self.finalizer is not None:
+            # the bitmap being consumed is the PARENT's commit proof,
+            # taken over the parent's epoch committee (matters on the
+            # first block after an election)
+            prev_epoch = self.epoch_of(max(block_num - 1, 0))
+            self.finalizer.finalize_block(
+                state, self.shard_state_for_epoch(prev_epoch),
+                self.shard_id, prev_bitmap,
+            )
+        if self.is_epoch_boundary(block_num):
+            self.processor.payout_undelegations(state, epoch)
+        if self.finalizer is not None and self.is_election_block(block_num):
+            self.finalizer.compute_epos_status(state, epoch)
+            return self.finalizer.elect(state, epoch + 1)
+        return None
+
+    def _execute(self, block: Block):
         state = self._state.copy()
         epoch = block.header.epoch
         result = self.processor.process(state, block, epoch)
-        if self.is_epoch_boundary(block.block_num):
-            self.processor.payout_undelegations(state, epoch)
+        elected = self.post_process(
+            state, block.block_num, epoch,
+            block.header.last_commit_bitmap or None,
+        )
         if state.root() != block.header.root:
             raise ChainError("state root mismatch after execution")
-        return state, result
+        return state, result, elected
 
     def insert_chain(self, blocks: list, commit_sigs: list | None = None,
                      verify_seals: bool = True) -> int:
@@ -187,7 +243,10 @@ class Blockchain:
         # execution + persistence pass
         inserted = 0
         for block, proof in zip(blocks, proofs):
-            state, result = self._execute(block)
+            state, result, elected = self._execute(block)
+            if elected is not None:
+                rawdb.write_shard_state(self.db, elected.epoch, elected)
+                self._committee_cache.pop(elected.epoch, None)
             rawdb.write_block(self.db, block, self.config.chain_id)
             rawdb.write_state(self.db, block.header.root, state.serialize())
             if proof is not None:

@@ -16,6 +16,7 @@ from .types import (
     Reader as _Reader,
     StakingTransaction,
     Transaction,
+    _enc_big,
     _enc_bytes,
     _enc_int,
 )
@@ -250,3 +251,56 @@ def decode_block(blob: bytes) -> Block:
     header = decode_header(r.bytes_())
     txs, stxs, cxs, order = decode_body(r.bytes_())
     return Block(header, txs, stxs, cxs, order)
+
+
+# -- shard state (per-epoch committees) -------------------------------------
+
+_SHARD_STATE = b"E"  # E || epoch(8) -> shard state blob
+
+
+def encode_shard_state(state) -> bytes:
+    """shard.committee.State codec (effective stakes carried as raw
+    Dec ints; None marks Harmony-operated slots)."""
+    out = bytearray()
+    out += _enc_int(state.epoch)
+    out += _enc_int(len(state.shards), 4)
+    for com in state.shards:
+        out += _enc_int(com.shard_id, 4)
+        out += _enc_int(len(com.slots), 4)
+        for s in com.slots:
+            out += _enc_bytes(s.ecdsa_address)
+            out += _enc_bytes(s.bls_pubkey)
+            if s.effective_stake is None:
+                out += b"\x00"
+            else:
+                out += b"\x01" + _enc_big(s.effective_stake.raw)
+    return bytes(out)
+
+
+def decode_shard_state(blob: bytes):
+    from ..numeric import Dec
+    from ..shard.committee import Committee, Slot, State
+
+    r = _Reader(blob)
+    state = State(epoch=r.int_())
+    for _ in range(r.int_(4)):
+        com = Committee(shard_id=r.int_(4))
+        for _ in range(r.int_(4)):
+            addr = r.bytes_()
+            key = r.bytes_()
+            has_stake = r.int_(1)
+            stake = None
+            if has_stake:
+                stake = Dec(r.big_())
+            com.slots.append(Slot(addr, key, stake))
+        state.shards.append(com)
+    return state
+
+
+def write_shard_state(db, epoch: int, state):
+    db.put(_num_key(_SHARD_STATE, epoch), encode_shard_state(state))
+
+
+def read_shard_state(db, epoch: int):
+    blob = db.get(_num_key(_SHARD_STATE, epoch))
+    return decode_shard_state(blob) if blob else None

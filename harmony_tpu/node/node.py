@@ -73,9 +73,12 @@ class Node:
     # -- committee / role ---------------------------------------------------
 
     def committee(self) -> list:
-        """Serialized pubkeys for the CURRENT epoch (the genesis
-        committee until election rotates it — shard/committee)."""
-        return list(self.chain.genesis.committee)
+        """Serialized pubkeys for the round's epoch: the elected shard
+        state when one exists, else genesis (shard/committee election
+        persisted at the committee-selection block)."""
+        return self.chain.committee_for_epoch(
+            self.chain.epoch_of(self.chain.head_number + 1)
+        )
 
     def leader_key(self, view_id: int) -> bytes:
         committee = self.committee()
@@ -217,15 +220,30 @@ class Node:
             return None
         if block.tx_root(self.chain.config.chain_id) != header.tx_root:
             return None
+        # the carried parent commit proof drives reward/availability
+        # state — it must be EXACTLY the proof this node committed for
+        # the parent (all honest nodes stored the same COMMITTED
+        # payload), or, where only an engine is wired, verify the seal.
+        # A fabricated bitmap would otherwise mis-assign rewards AND
+        # fork live state from sync replay.
+        if header.block_num > 1:
+            carried = header.last_commit_sig + header.last_commit_bitmap
+            local = self.chain.read_commit_sig(head.block_num)
+            if local is not None:
+                if carried != local:
+                    return None
+            elif self.chain.engine is not None:
+                if not self.chain.engine.verify_seal(head, header):
+                    return None
+            elif carried:
+                return None  # unverifiable proof: reject
         try:
             state = self.chain.state().copy()
-            self.chain.processor.process(
-                state, block, header.epoch
+            self.chain.processor.process(state, block, header.epoch)
+            self.chain.post_process(
+                state, header.block_num, header.epoch,
+                header.last_commit_bitmap or None,
             )
-            if self.chain.is_epoch_boundary(header.block_num):
-                self.chain.processor.payout_undelegations(
-                    state, header.epoch
-                )
             if state.root() != header.root:
                 return None
         except ValueError:

@@ -67,8 +67,12 @@ class Worker:
                     continue
         for cx in incoming_receipts or []:
             self.chain.processor.apply_incoming_receipt(state, cx)
-        if self.chain.is_epoch_boundary(num):
-            self.chain.processor.payout_undelegations(state, epoch)
+        # the parent's quorum proof rides in this header (reference:
+        # block/header LastCommitSignature/Bitmap) and drives reward +
+        # availability finalization
+        parent_proof = self.chain.read_commit_sig(parent.block_num) or b""
+        last_sig, last_bitmap = parent_proof[:96], parent_proof[96:]
+        self.chain.post_process(state, num, epoch, last_bitmap or None)
 
         block = Block(
             None,
@@ -86,6 +90,8 @@ class Worker:
             root=state.root(),
             tx_root=block.tx_root(self.chain.config.chain_id),
             timestamp=timestamp,
+            last_commit_sig=last_sig,
+            last_commit_bitmap=last_bitmap,
             extra=leader_extra,
         )
         return block

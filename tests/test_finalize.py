@@ -1,0 +1,174 @@
+"""Epoch lifecycle: rewards, availability, EPoS election, committee
+rotation (the reference's Finalize path — SURVEY.md §3.4 — end to end
+on a real chain)."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.chain.finalize import FinalizeConfig, Finalizer
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.core.types import Directive, StakingTransaction
+from harmony_tpu.node.worker import Worker
+
+CHAIN_ID = 2
+BPE = 4  # blocks per epoch
+
+
+def _setup():
+    genesis, ecdsa_keys, bls_keys = dev_genesis()
+    harmony_accounts = [
+        (k.address(), pub)
+        for k, pub in zip(ecdsa_keys, genesis.committee)
+    ]
+    fin = Finalizer(FinalizeConfig(
+        block_reward=28 * 10**18,
+        shard_count=1,
+        external_slots_per_shard=2,
+        harmony_accounts=harmony_accounts,
+    ))
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=BPE,
+                       finalizer=fin)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    return chain, pool, genesis, ecdsa_keys
+
+
+def _advance(chain, pool, n=1, bitmap_bytes=None):
+    """Commit n blocks; store a full-participation commit proof for
+    each so the NEXT block's finalize sees its bitmap."""
+    worker = Worker(chain, pool)
+    for _ in range(n):
+        block = worker.propose_block(view_id=chain.head_number + 1)
+        assert chain.insert_chain([block], verify_seals=False) == 1
+        committee = chain.committee_for_epoch(
+            chain.epoch_of(block.block_num)
+        )
+        nbytes = (len(committee) + 7) >> 3
+        bitmap = bitmap_bytes if bitmap_bytes is not None else (
+            bytes([0xFF] * nbytes)
+        )
+        # trim overflow bits beyond committee size
+        full = bytearray(bitmap[:nbytes])
+        extra = nbytes * 8 - len(committee)
+        if extra:
+            full[-1] &= 0xFF >> extra
+        chain.write_commit_sig(
+            block.block_num, b"\x01" * 96 + bytes(full)
+        )
+        pool.drop_applied()
+
+
+def test_election_rotates_committee_and_pays_rewards():
+    chain, pool, genesis, ecdsa_keys = _setup()
+    ext_bls = B.PrivateKey.generate(b"external-validator-key")
+    staker = ecdsa_keys[0]
+
+    stx = StakingTransaction(
+        nonce=0, gas_price=1, gas_limit=50_000,
+        directive=Directive.CREATE_VALIDATOR,
+        fields={
+            "amount": 10**20,
+            "min_self_delegation": 10**18,
+            "bls_keys": ext_bls.pub.bytes,
+        },
+    ).sign(staker, CHAIN_ID)
+    pool.add(stx, is_staking=True)
+
+    # epoch 0: blocks 1..3 (block 3 is the election block)
+    _advance(chain, pool, 3)
+    assert chain.is_election_block(3)
+    elected = chain.shard_state_for_epoch(1)
+    assert elected is not None
+    com = elected.find_committee(0)
+    keys = com.bls_pubkeys()
+    # 4 harmony slots + the external winner
+    assert len(keys) == 5
+    assert ext_bls.pub.bytes in keys
+    ext_slot = [s for s in com.slots if s.effective_stake is not None]
+    assert len(ext_slot) == 1
+    assert chain.committee_for_epoch(1) == keys
+    assert chain.committee_for_epoch(0) == list(genesis.committee)
+
+    # epoch 1: the external validator signs (full bitmaps) and earns
+    w_before = chain.state().validator(staker.address())
+    assert w_before.blocks_to_sign == 0
+    _advance(chain, pool, 2)  # blocks 4, 5 (block 5 sees block 4's bitmap)
+    w = chain.state().validator(staker.address())
+    # block 5's finalize consumed block 4's 5-slot bitmap
+    assert w.blocks_to_sign == 1 and w.blocks_signed == 1
+    d = w.delegations[0]
+    assert d.delegator == staker.address()
+    assert d.reward == 28 * 10**18  # sole external signer gets it all
+
+
+def test_missing_signer_goes_inactive_at_election():
+    chain, pool, genesis, ecdsa_keys = _setup()
+    ext_bls = B.PrivateKey.generate(b"lazy-validator-key")
+    staker = ecdsa_keys[1]
+    stx = StakingTransaction(
+        nonce=0, gas_price=1, gas_limit=50_000,
+        directive=Directive.CREATE_VALIDATOR,
+        fields={
+            "amount": 10**20,
+            "min_self_delegation": 10**18,
+            "bls_keys": ext_bls.pub.bytes,
+        },
+    ).sign(staker, CHAIN_ID)
+    pool.add(stx, is_staking=True)
+    _advance(chain, pool, 3)  # elected into epoch 1
+    assert ext_bls.pub.bytes in chain.committee_for_epoch(1)
+
+    # epoch 1: bitmaps mark only the 4 harmony slots; slot 5 never signs
+    _advance(chain, pool, 4, bitmap_bytes=bytes([0x0F]))
+    # the election block of epoch 1 (block 7) saw 0-of-N signing and
+    # flipped the validator inactive; epoch 2's committee drops it
+    w = chain.state().validator(staker.address())
+    assert w.status == 1
+    assert ext_bls.pub.bytes not in chain.committee_for_epoch(2)
+    # harmony fallback committee still present
+    assert len(chain.committee_for_epoch(2)) == 4
+
+
+def test_bits_from_bytes_short_bitmap_raises_valueerror():
+    """A truncated bitmap must raise ValueError (callers catch it on
+    untrusted input), never IndexError."""
+    from harmony_tpu.consensus.mask import bits_from_bytes
+
+    with pytest.raises(ValueError):
+        bits_from_bytes(b"\x01", 9)
+    assert bits_from_bytes(b"\x01\x01", 9) == [1, 0, 0, 0, 0, 0, 0, 0, 1]
+
+
+def test_fabricated_parent_proof_rejected_by_validator():
+    """A proposal whose header carries a parent commit proof different
+    from the locally committed one is rejected before voting (the
+    bitmap drives reward state)."""
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.rawdb import encode_block, decode_block
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=1)
+    net = InProcessNetwork()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    reg = Registry(blockchain=chain, txpool=pool, host=net.host("solo"))
+    node = Node(reg, PrivateKeys.from_keys(bls_keys))
+    node.start_round_if_leader()
+    assert chain.head_number == 1
+
+    good = Worker(chain, None).propose_block(view_id=2)
+    assert node._validate_proposed_block(
+        encode_block(good, CHAIN_ID)
+    ) is not None
+    forged = Worker(chain, None).propose_block(view_id=2)
+    forged.header.last_commit_bitmap = bytes(
+        [forged.header.last_commit_bitmap[0] ^ 0x02]
+    ) + forged.header.last_commit_bitmap[1:]
+    assert node._validate_proposed_block(
+        encode_block(forged, CHAIN_ID)
+    ) is None
