@@ -65,10 +65,12 @@ class _LRU(OrderedDict):
 
 
 # Device batches are padded up to one of these pinned sizes (chunked
-# above the largest) so EVERY verify reuses one of three compiled
-# programs — no shape-polymorphic recompiles on the hot path
-# (SURVEY.md §7.3: "pinned batch shapes with bucketing").
-VERIFY_BUCKETS = (8, 64, 256)
+# above the largest) so EVERY verify reuses a precompiled program — no
+# shape-polymorphic recompiles on the hot path (SURVEY.md §7.3:
+# "pinned batch shapes with bucketing").  Capped at 64: XLA:CPU's LLVM
+# JIT hits allocation failures compiling the 256-wide programs on the
+# test image (TPU compiles are fine; revisit the cap on real hardware).
+VERIFY_BUCKETS = (8, 64)
 
 
 def bucket_size(n: int) -> int:

@@ -1,0 +1,196 @@
+"""The node binary: config, wiring, service lifecycle.
+
+The role of the reference's cmd/harmony (reference:
+cmd/harmony/main.go:106-1107 — config load, chain setup, consensus +
+node wiring, service registration, RPC startup; TOML config tree
+internal/configs/harmony/harmony.go:18-44).  Stdlib-only: argparse +
+tomllib; every subsystem built here exists as a library object, so
+this file is wiring, not logic.
+
+Run: python -m harmony_tpu.cli --config node.toml  (or flags only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import tomllib
+
+from .config.chain import ChainConfig
+from .core.blockchain import Blockchain
+from .core.genesis import Genesis, dev_genesis
+from .core.kv import FileKV, MemKV
+from .core.tx_pool import TxPool
+from .hmy import Harmony
+from .keystore import load_keys
+from .metrics import MetricsServer, Registry as MetricsRegistry
+from .multibls import PrivateKeys
+from .node.node import Node
+from .node.registry import Registry
+from .node.services import Manager, Service, ServiceType
+from .p2p import TCPHost
+from .p2p.stream import SyncClient, SyncServer
+from .rpc import RPCServer
+from .sync import Downloader
+
+DEFAULTS = {
+    "network": "localnet",
+    "shard_id": 0,
+    "datadir": "./harmony_tpu_data",
+    "blocks_per_epoch": 32768,
+    "rpc_port": 9500,
+    "metrics_port": 9900,
+    "p2p_port": 9000,
+    "sync_port": 9001,
+    "peers": [],          # "host:port" gossip peers
+    "sync_peers": [],     # "host:port" sync stream servers
+    "bls_keys": [],       # [{"path": ..., "passphrase_file": ...}]
+    "in_memory": False,
+}
+
+
+def load_config(path: str | None, overrides: dict) -> dict:
+    cfg = dict(DEFAULTS)
+    if path:
+        with open(path, "rb") as f:
+            cfg.update(tomllib.load(f))
+    cfg.update({k: v for k, v in overrides.items() if v is not None})
+    return cfg
+
+
+class _CallbackService(Service):
+    def __init__(self, start_fn, stop_fn):
+        self._start, self._stop = start_fn, stop_fn
+
+    def start(self):
+        self._start()
+
+    def stop(self):
+        self._stop()
+
+
+def build_node(cfg: dict):
+    """Wire every subsystem; returns (node, services, registry)."""
+    os.makedirs(cfg["datadir"], exist_ok=True)
+
+    dev_bls = None
+    if cfg.get("genesis") is not None:
+        genesis = cfg["genesis"]  # tests inject a Genesis object
+    else:
+        genesis, _, dev_bls = dev_genesis(shard_id=cfg["shard_id"])
+
+    db = (
+        MemKV() if cfg["in_memory"]
+        else FileKV(os.path.join(cfg["datadir"],
+                                 f"shard{cfg['shard_id']}.db"))
+    )
+    chain = Blockchain(db, genesis,
+                       blocks_per_epoch=cfg["blocks_per_epoch"])
+    pool = TxPool(genesis.config.chain_id, cfg["shard_id"], chain.state)
+
+    # BLS keys: encrypted keyfiles, or dev keys on the dev genesis
+    if cfg["bls_keys"]:
+        pairs = []
+        for entry in cfg["bls_keys"]:
+            with open(entry["passphrase_file"]) as f:
+                pairs.append((entry["path"], f.read().strip()))
+        keys = PrivateKeys.from_keys(load_keys(pairs))
+    elif dev_bls is not None:
+        keys = PrivateKeys.from_keys(dev_bls)
+    else:
+        raise ValueError(
+            "bls_keys required when a custom genesis is supplied"
+        )
+
+    host = TCPHost(name=f"shard{cfg['shard_id']}-{os.getpid()}",
+                   listen_port=cfg["p2p_port"])
+    for peer in cfg["peers"]:
+        addr, _, port = peer.rpartition(":")
+        host.connect(int(port), addr or "127.0.0.1")
+
+    reg = Registry(blockchain=chain, txpool=pool, host=host)
+    node = Node(reg, keys, network=cfg["network"])
+    hmy = Harmony(chain, pool, node)
+
+    manager = Manager()
+
+    rpc = RPCServer(hmy, port=cfg["rpc_port"])
+    manager.register(
+        ServiceType.CLIENT_SUPPORT,
+        _CallbackService(rpc.start, rpc.stop),
+    )
+
+    metrics_reg = MetricsRegistry()
+    reg.set("metrics", metrics_reg)
+    metrics = MetricsServer(metrics_reg, port=cfg["metrics_port"])
+    manager.register(
+        ServiceType.PROMETHEUS,
+        _CallbackService(metrics.start, metrics.stop),
+    )
+
+    sync_srv = SyncServer(chain, listen_port=cfg["sync_port"])
+    manager.register(
+        ServiceType.SYNCHRONIZE,
+        _CallbackService(lambda: None, sync_srv.close),
+    )
+
+    if cfg["sync_peers"]:
+        clients = []
+        for peer in cfg["sync_peers"]:
+            addr, _, port = peer.rpartition(":")
+            clients.append(SyncClient(int(port), addr or "127.0.0.1"))
+        downloader = Downloader(chain, clients,
+                                verify_seals=chain.engine is not None)
+        downloader.sync_once()  # catch up before consensus starts
+
+    consensus_thread: list = []
+    manager.register(
+        ServiceType.CONSENSUS,
+        _CallbackService(
+            lambda: consensus_thread.append(node.run_forever()),
+            node.stop,
+        ),
+    )
+    return node, manager, reg, rpc, metrics
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="harmony-tpu")
+    p.add_argument("--config", help="TOML config file")
+    p.add_argument("--network")
+    p.add_argument("--shard-id", type=int, dest="shard_id")
+    p.add_argument("--datadir")
+    p.add_argument("--rpc-port", type=int, dest="rpc_port")
+    p.add_argument("--metrics-port", type=int, dest="metrics_port")
+    p.add_argument("--p2p-port", type=int, dest="p2p_port")
+    p.add_argument("--sync-port", type=int, dest="sync_port")
+    p.add_argument("--peer", action="append", dest="peers")
+    p.add_argument("--sync-peer", action="append", dest="sync_peers")
+    args = p.parse_args(argv)
+    cfg = load_config(args.config, vars(args))
+
+    node, manager, reg, rpc, metrics = build_node(cfg)
+    manager.start_services()
+    print(
+        f"harmony-tpu node up: shard {cfg['shard_id']} "
+        f"rpc :{rpc.port} metrics :{metrics.port} "
+        f"p2p :{node.host.port}",
+        flush=True,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        manager.stop_services()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,8 +41,23 @@ from .signature import construct_commit_payload, prepare_payload
 class RoundConfig:
     committee: list  # ordered serialized pubkeys (the epoch committee)
     block_num: int
-    view_id: int
+    view_id: int  # message routing view
     is_staking: bool = True
+    # the view id bound into commit payloads: the BLOCK HEADER's view.
+    # Equal to view_id in normal rounds; after a view change re-proposes
+    # a prepared block, it stays the ORIGINAL proposal view so commit
+    # votes cast across views bind the same payload (PBFT safety: the
+    # re-proposed block must be THE SAME block, hash included) and the
+    # engine's replay check (which derives the payload from the header,
+    # engine.py _commit_payload) agrees with live consensus.
+    payload_view_id: int | None = None
+
+    @property
+    def commit_view_id(self) -> int:
+        return (
+            self.view_id if self.payload_view_id is None
+            else self.payload_view_id
+        )
 
 
 class _Node:
@@ -57,7 +72,7 @@ class _Node:
 
     def _commit_payload(self, block_hash: bytes) -> bytes:
         return construct_commit_payload(
-            block_hash, self.cfg.block_num, self.cfg.view_id,
+            block_hash, self.cfg.block_num, self.cfg.commit_view_id,
             self.cfg.is_staking,
         )
 

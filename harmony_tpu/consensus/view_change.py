@@ -269,3 +269,87 @@ def verify_new_view(
         ):
             return False
     return True
+
+
+# -- wire codecs -------------------------------------------------------------
+
+def _enc_b(b: bytes) -> bytes:
+    return len(b).to_bytes(4, "little") + b
+
+
+class _Cur:
+    """Bounds-checked cursor: any read past end-of-buffer raises
+    ValueError — truncated or length-forged wire input must fail fast,
+    never silently yield empty fields or huge allocations."""
+
+    def __init__(self, data: bytes):
+        self.v = memoryview(data)
+        self.o = 0
+
+    def _take(self, n: int) -> memoryview:
+        if self.o + n > len(self.v):
+            raise ValueError("truncated view-change message")
+        out = self.v[self.o:self.o + n]
+        self.o += n
+        return out
+
+    def b(self) -> bytes:
+        ln = int.from_bytes(self._take(4), "little")
+        return bytes(self._take(ln))
+
+    def i(self, w=8) -> int:
+        return int.from_bytes(self._take(w), "little")
+
+    def count(self, cap: int = 4096) -> int:
+        n = self.i(4)
+        if n > cap:
+            raise ValueError(f"absurd element count {n}")
+        return n
+
+
+def encode_viewchange(msg: ViewChangeMsg) -> bytes:
+    out = bytearray()
+    out += msg.view_id.to_bytes(8, "little")
+    out += msg.block_num.to_bytes(8, "little")
+    out += len(msg.sender_pubkeys).to_bytes(4, "little")
+    for pk in msg.sender_pubkeys:
+        out += _enc_b(pk)
+    for fieldval in (msg.m3_sig, msg.m2_sig, msg.m1_sig, msg.m1_payload):
+        out += _enc_b(fieldval)
+    return bytes(out)
+
+
+def decode_viewchange(data: bytes) -> ViewChangeMsg:
+    c = _Cur(data)
+    view_id, block_num = c.i(), c.i()
+    keys = [c.b() for _ in range(c.count())]
+    m3, m2, m1, m1p = c.b(), c.b(), c.b(), c.b()
+    return ViewChangeMsg(
+        view_id=view_id, block_num=block_num, sender_pubkeys=keys,
+        m3_sig=m3, m2_sig=m2, m1_sig=m1, m1_payload=m1p,
+    )
+
+
+def encode_newview(msg: NewViewMsg) -> bytes:
+    out = bytearray()
+    out += msg.view_id.to_bytes(8, "little")
+    out += msg.block_num.to_bytes(8, "little")
+    out += len(msg.leader_pubkeys).to_bytes(4, "little")
+    for pk in msg.leader_pubkeys:
+        out += _enc_b(pk)
+    for fv in (msg.m3_agg_sig, msg.m3_bitmap, msg.m2_agg_sig,
+               msg.m2_bitmap, msg.m1_payload):
+        out += _enc_b(fv)
+    return bytes(out)
+
+
+def decode_newview(data: bytes) -> NewViewMsg:
+    c = _Cur(data)
+    view_id, block_num = c.i(), c.i()
+    keys = [c.b() for _ in range(c.count())]
+    m3s, m3b, m2s, m2b, m1p = c.b(), c.b(), c.b(), c.b(), c.b()
+    return NewViewMsg(
+        view_id=view_id, block_num=block_num, leader_pubkeys=keys,
+        m3_agg_sig=m3s, m3_bitmap=m3b, m2_agg_sig=m2s, m2_bitmap=m2b,
+        m1_payload=m1p,
+    )

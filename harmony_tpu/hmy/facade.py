@@ -1,0 +1,146 @@
+"""Harmony facade: chain/txpool/staking reads behind one object.
+
+Behavioral parity with the reference's facade (reference:
+hmy/hmy.go:48-85: BlockChain + TxPool + caches for leader, total
+stake, validator information; rpc namespaces call only this).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core import rawdb
+from ..core.tx_pool import PoolError
+
+
+class Harmony:
+    def __init__(self, chain, tx_pool=None, node=None):
+        self.chain = chain
+        self.tx_pool = tx_pool
+        self.node = node  # optional: consensus state reads
+        self._lock = threading.Lock()
+        self._total_stake_cache: tuple | None = None  # (epoch, value)
+
+    # -- chain reads --------------------------------------------------------
+
+    def block_number(self) -> int:
+        return self.chain.head_number
+
+    def header_by_number(self, num: int):
+        return self.chain.header_by_number(num)
+
+    def block_by_number(self, num: int):
+        if num < 0:  # "latest"
+            num = self.chain.head_number
+        return self.chain.block_by_number(num)
+
+    def block_by_hash(self, block_hash: bytes):
+        return self.chain.block_by_hash(block_hash)
+
+    def get_balance(self, address: bytes, block_num: int | None = None):
+        if block_num is None or block_num >= self.chain.head_number:
+            return self.chain.state().balance(address)
+        return self.chain.state_at(block_num).balance(address)
+
+    def get_nonce(self, address: bytes) -> int:
+        return self.chain.state().nonce(address)
+
+    def chain_id(self) -> int:
+        return self.chain.config.chain_id
+
+    def shard_id(self) -> int:
+        return self.chain.shard_id
+
+    def current_epoch(self) -> int:
+        return self.chain.epoch_of(self.chain.head_number)
+
+    def committee(self, epoch: int | None = None) -> list:
+        if epoch is None:
+            epoch = self.current_epoch()
+        return self.chain.committee_for_epoch(epoch)
+
+    def read_commit_sig(self, num: int):
+        return self.chain.read_commit_sig(num)
+
+    def get_transaction(self, tx_hash: bytes):
+        """(block_num, index, tx) or None — linear scan fallback; an
+        index column is a straightforward rawdb extension."""
+        for num in range(self.chain.head_number, 0, -1):
+            block = self.chain.block_by_number(num)
+            if block is None:
+                continue
+            for i, tx in enumerate(block.transactions):
+                if tx.hash(self.chain.config.chain_id) == tx_hash:
+                    return num, i, tx
+        return None
+
+    # -- staking reads ------------------------------------------------------
+
+    def validator_addresses(self) -> list:
+        return self.chain.state().validator_addresses()
+
+    def validator_information(self, address: bytes):
+        w = self.chain.state().validator(address)
+        if w is None:
+            return None
+        return {
+            "address": "0x" + address.hex(),
+            "bls_keys": [k.hex() for k in w.bls_keys],
+            "total_delegation": w.total_delegation(),
+            "self_delegation": w.self_delegation(),
+            "min_self_delegation": w.min_self_delegation,
+            "commission_rate": w.commission_rate,
+            "status": ("active", "inactive", "banned")[w.status],
+            "blocks_signed": w.blocks_signed,
+            "blocks_to_sign": w.blocks_to_sign,
+            "last_epoch_in_committee": w.last_epoch_in_committee,
+            "delegations": [
+                {
+                    "delegator": "0x" + d.delegator.hex(),
+                    "amount": d.amount,
+                    "reward": d.reward,
+                    "undelegations": [
+                        {"amount": a, "epoch": e}
+                        for a, e in d.undelegations
+                    ],
+                }
+                for d in w.delegations
+            ],
+        }
+
+    def total_staking(self) -> int:
+        """Network total delegation (cached per epoch — hmy.go:73
+        totalStakeCache)."""
+        epoch = self.current_epoch()
+        with self._lock:
+            if (
+                self._total_stake_cache is not None
+                and self._total_stake_cache[0] == epoch
+            ):
+                return self._total_stake_cache[1]
+        state = self.chain.state()
+        total = sum(
+            state.validator(a).total_delegation()
+            for a in state.validator_addresses()
+        )
+        with self._lock:
+            self._total_stake_cache = (epoch, total)
+        return total
+
+    # -- writes -------------------------------------------------------------
+
+    def send_raw_transaction(self, blob: bytes) -> bytes:
+        """Decode, admit to the pool, return the tx hash (reference:
+        SendRawTransaction -> AddPendingTransaction)."""
+        if self.tx_pool is None:
+            raise PoolError("node has no transaction pool")
+        tx = rawdb.decode_tx(blob)
+        self.tx_pool.add(tx)
+        return tx.hash(self.chain.config.chain_id)
+
+    def send_raw_staking_transaction(self, blob: bytes) -> bytes:
+        if self.tx_pool is None:
+            raise PoolError("node has no transaction pool")
+        tx = rawdb.decode_staking_tx(blob)
+        self.tx_pool.add(tx, is_staking=True)
+        return tx.hash(self.chain.config.chain_id)

@@ -71,8 +71,11 @@ def validate_consensus_message(
     if shard_id != ctx.shard_id:
         return IngressResult(False, "wrong shard")
     if msg.msg_type in _VIEWCHANGE_TYPES:
-        if not ctx.in_view_change:
-            return IngressResult(False, "not in view change")
+        # acceptable while in view change, or for a FUTURE view even
+        # before this node's own timeout fires (peers' clocks lead ours;
+        # the reference accepts view-change traffic for viewID > current)
+        if not ctx.in_view_change and msg.view_id <= ctx.current_view_id:
+            return IngressResult(False, "view change for a stale view")
     else:
         if msg.view_id + VIEW_ID_WINDOW < ctx.current_view_id:
             return IngressResult(False, "view id too old")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from ..consensus.fbft import Leader, RoundConfig, Validator
 from ..consensus.messages import (
@@ -31,6 +32,15 @@ from ..consensus.messages import (
 )
 from ..consensus.quorum import Decider, Policy
 from ..consensus.sender import MessageSender
+from ..consensus.view_change import (
+    ViewChangeCollector,
+    construct_viewchange,
+    decode_newview,
+    decode_viewchange,
+    encode_newview,
+    encode_viewchange,
+    verify_new_view,
+)
 from ..core import rawdb
 from ..core.blockchain import ChainError
 from ..multibls import PrivateKeys
@@ -64,7 +74,13 @@ class Node:
         self._queue: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self.committed_blocks = 0
+        self.dropped_messages = 0
         self._vc = 0  # view changes since last commit
+        self.in_view_change = False
+        self.phase_timeout = 27.0  # reference: consensus/config.go:10
+        self._vc_collector = None
+        self._prepared_proof: bytes | None = None  # [sig||bitmap] seen
+        self._prepared_block_bytes: bytes = b""
 
         self.host.add_validator(self.topic, self._gossip_validator)
         self.host.subscribe(self.topic, self._on_gossip)
@@ -87,7 +103,7 @@ class Node:
     @property
     def is_leader(self) -> bool:
         return any(
-            k.pub.bytes == self.leader_key(self.view_id) for k in self.keys
+            k.pub.bytes == self._round_leader_key for k in self.keys
         )
 
     # -- round lifecycle ----------------------------------------------------
@@ -114,6 +130,16 @@ class Node:
         self._sent_prepared = False
         self._sent_committed = False
         self._pending_block = None  # validator's decoded announce block
+        self._round_leader_key = self.leader_key(self.view_id)
+        self._round_start = time.monotonic()
+        self.in_view_change = False
+        self._vc_collector = None
+        self._vc_pending: list = []  # VC votes that arrived early
+        self._vc_block_bytes = b""
+        self._prepared_proof = None
+        self._prepared_block_bytes = b""
+        self._reproposal = None  # block carried through a view change
+        self._expected_reproposal_hash = None
 
     # -- gossip ingress -----------------------------------------------------
 
@@ -133,6 +159,7 @@ class Node:
             current_view_id=self.view_id,
             committee_keys=set(self.committee()),
             is_leader=self.is_leader,
+            in_view_change=self.in_view_change,
         )
         result = validate_consensus_message(msg, ctx, self.chain.shard_id)
         return ACCEPT if result.accepted else IGNORE
@@ -157,7 +184,14 @@ class Node:
         WaitForConsensusReadyV2 -> ProposeNewBlock -> announce)."""
         if not self.is_leader or self._proposed:
             return None
-        block = self.worker.propose_block(view_id=self.view_id)
+        if self._reproposal is not None:
+            # re-announce the view-change-carried block UNCHANGED (same
+            # hash — PBFT safety); commit payloads bind its original view
+            block = self._reproposal
+            self._reproposal = None
+            self.leader.cfg.payload_view_id = block.header.view_id
+        else:
+            block = self.worker.propose_block(view_id=self.view_id)
         block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
         self._pending_block = block
         self._proposed = True
@@ -199,9 +233,17 @@ class Node:
             MsgType.PREPARED: self._on_prepared,
             MsgType.COMMIT: self._on_commit,
             MsgType.COMMITTED: self._on_committed,
+            MsgType.VIEWCHANGE: self._on_viewchange_msg,
+            MsgType.NEWVIEW: self._on_newview_msg,
         }.get(msg.msg_type)
-        if handler is not None:
+        if handler is None:
+            return
+        try:
             handler(msg)
+        except Exception:
+            # tolerant message loop (the reference logs and moves on):
+            # one malformed message must never kill the consensus pump
+            self.dropped_messages += 1
 
     # -- FBFT phase handlers ------------------------------------------------
 
@@ -217,6 +259,14 @@ class Node:
         if header.block_num != head.block_num + 1:
             return None
         if header.parent_hash != head.hash():
+            return None
+        # header.view_id must be the round view — or the exact block a
+        # verified NEWVIEW carried (re-proposals keep their original
+        # view, but only for the hash the view-change quorum attested)
+        if header.view_id != self.view_id and (
+            self._expected_reproposal_hash is None
+            or block.hash() != self._expected_reproposal_hash
+        ):
             return None
         if block.tx_root(self.chain.config.chain_id) != header.tx_root:
             return None
@@ -253,14 +303,22 @@ class Node:
     def _on_announce(self, msg: FBFTMessage):
         if self.is_leader:
             return
-        if msg.sender_pubkeys and msg.sender_pubkeys[0] != self.leader_key(
-            msg.view_id
+        # bind to THIS round's view and ITS designated leader — a
+        # committee member must not be able to pick a view id whose
+        # rotation lands on itself (leader capture)
+        if msg.view_id != self.view_id:
+            return
+        if not msg.sender_pubkeys or (
+            msg.sender_pubkeys[0] != self._round_leader_key
         ):
-            return  # announce not from the round's leader
+            return
         block = self._validate_proposed_block(msg.block)
         if block is None:
             return
         self._pending_block = block
+        # commit payloads bind the block header's own view (differs from
+        # the round view only for a view-change re-proposal)
+        self.validator.cfg.payload_view_id = block.header.view_id
         vote = self.validator.on_announce(msg)
         self._broadcast(vote)
 
@@ -298,6 +356,15 @@ class Node:
             return
         vote = self.validator.on_prepared(msg)
         if vote is not None:
+            # remember the prepared proof: a view change must carry it
+            # (M1) so the block survives the leader's failure
+            self._prepared_proof = msg.payload
+            if msg.block:
+                self._prepared_block_bytes = msg.block
+            elif self._pending_block is not None:
+                self._prepared_block_bytes = rawdb.encode_block(
+                    self._pending_block, self.chain.config.chain_id
+                )
             self._broadcast(vote)
 
     def _on_commit(self, msg: FBFTMessage):
@@ -335,12 +402,165 @@ class Node:
         self._sent_committed = False
         self._new_round()
 
+    # -- view change (reference: consensus/view_change.go:220-553) ----------
+
+    def start_view_change(self):
+        """Phase timeout: vote to move to the next view (startViewChange).
+        Carries the prepared proof (M1) when this node saw PREPARED —
+        the half-done block must survive into the new view."""
+        self._vc += 1
+        head = self.chain.current_header()
+        new_view = head.view_id + 1 + self._vc
+        self.in_view_change = True
+        prepared_hash = None
+        if self._prepared_proof is not None and self._pending_block is not None:
+            prepared_hash = self._pending_block.hash()
+        vc = construct_viewchange(
+            self.keys, new_view, self.block_num,
+            prepared_hash, self._prepared_proof,
+        )
+        msg = FBFTMessage(
+            msg_type=MsgType.VIEWCHANGE,
+            view_id=new_view,
+            block_num=self.block_num,
+            block_hash=prepared_hash or bytes(32),
+            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            payload=encode_viewchange(vc),
+            block=self._prepared_block_bytes if prepared_hash else b"",
+        )
+        self._round_start = time.monotonic()
+        # the view's designated leader collects VC votes — start my
+        # collector (and self-vote) if that's me
+        if any(
+            k.pub.bytes == self.leader_key(new_view) for k in self.keys
+        ):
+            committee = self.committee()
+            self._vc_collector = ViewChangeCollector(
+                committee, Decider(self.policy, committee, self.roster),
+                new_view,
+            )
+            self._vc_collector.on_viewchange(vc)
+            if prepared_hash:
+                self._vc_block_bytes = self._prepared_block_bytes
+            # votes that arrived before our own timeout
+            pending, self._vc_pending = self._vc_pending, []
+            for early in pending:
+                self._on_viewchange_msg(early)
+            self._try_new_view(new_view)
+        self._broadcast(msg, retry=True)
+
+    def _on_viewchange_msg(self, msg: FBFTMessage):
+        """Next-leader side: collect votes (onViewChange)."""
+        if not self.in_view_change:
+            # a peer timed out before us: buffer until our own timeout
+            # enters the view change (votes must not be lost to races);
+            # bounded — forged gossip must not grow memory
+            if msg.view_id > self.view_id and len(self._vc_pending) < 64:
+                self._vc_pending.append(msg)
+            return
+        if self._vc_collector is None or (
+            msg.view_id != self._vc_collector.view_id
+        ):
+            return
+        try:
+            vc = decode_viewchange(msg.payload)
+        except (ValueError, IndexError):
+            return
+        if self._vc_collector.on_viewchange(vc) and vc.m1_payload:
+            if msg.block:
+                self._vc_block_bytes = msg.block
+        self._try_new_view(msg.view_id)
+
+    def _try_new_view(self, new_view: int):
+        nv = self._vc_collector.try_new_view(self.block_num, self.keys)
+        if nv is None:
+            return
+        block_bytes = (
+            getattr(self, "_vc_block_bytes", b"") if nv.m1_payload else b""
+        )
+        out = FBFTMessage(
+            msg_type=MsgType.NEWVIEW,
+            view_id=new_view,
+            block_num=self.block_num,
+            block_hash=(nv.m1_payload[:32] if nv.m1_payload
+                        else bytes(32)),
+            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            payload=encode_newview(nv),
+            block=block_bytes,
+        )
+        self._broadcast(out, retry=True)
+        self._adopt_new_view(new_view, nv, block_bytes)
+
+    def _on_newview_msg(self, msg: FBFTMessage):
+        """Validator side: verify the NEWVIEW proof, adopt the view
+        (onNewView).  Accepted even before this node's own timeout —
+        the quorum proof inside is what gates adoption."""
+        try:
+            nv = decode_newview(msg.payload)
+        except (ValueError, IndexError):
+            return
+        # the ADOPTED view is the SIGNED one (nv.view_id, attested by
+        # the M3 quorum); the unsigned envelope must agree, and the
+        # view must be strictly newer than anything committed/active —
+        # a rewrapped old proof must not steer views
+        if nv.view_id != msg.view_id:
+            return
+        if nv.view_id <= self.chain.current_header().view_id:
+            return
+        if not self.in_view_change and nv.view_id <= self.view_id:
+            return
+        if not msg.sender_pubkeys or (
+            msg.sender_pubkeys[0] != self.leader_key(nv.view_id)
+        ):
+            return  # NEWVIEW must come from the view's designated leader
+        committee = self.committee()
+        decider = Decider(self.policy, committee, self.roster)
+        if not verify_new_view(nv, committee, decider):
+            return
+        self._adopt_new_view(nv.view_id, nv, msg.block)
+
+    def _adopt_new_view(self, new_view: int, nv, block_bytes: bytes):
+        """Everyone: move to the new view; the new leader re-proposes
+        the carried prepared block, or proposes fresh."""
+        head = self.chain.current_header()
+        self._vc = max(new_view - head.view_id - 1, 0)
+        reproposal = None
+        if nv.m1_payload and block_bytes:
+            try:
+                block = rawdb.decode_block(block_bytes)
+                if block.hash() == nv.m1_payload[:32]:
+                    reproposal = block
+            except (ValueError, IndexError):
+                reproposal = None
+        self._new_round()
+        self._reproposal = reproposal
+        if nv.m1_payload:
+            self._expected_reproposal_hash = nv.m1_payload[:32]
+
     # -- live mode ----------------------------------------------------------
 
-    def run_forever(self, poll_interval: float = 0.01):
+    def run_forever(self, poll_interval: float = 0.01,
+                    block_time: float = 2.0):
+        """Drive the pump; the leader proposes at most every
+        ``block_time`` seconds (reference: mainnet 2 s block period,
+        internal/params/config.go:740 IsTwoSeconds)."""
+
         def loop():
+            last_propose = 0.0
             while not self._stop.is_set():
-                self.start_round_if_leader()
+                now = time.monotonic()
+                if now - last_propose >= block_time:
+                    if self.start_round_if_leader() is not None:
+                        last_propose = now
+                if (
+                    now - self._round_start > self.phase_timeout
+                    and self.chain.head_number + 1 == self.block_num
+                ):
+                    # fires again while ALREADY in view change: each
+                    # timeout escalates to the next view/leader (the
+                    # reference restarts VC with growing timeouts — a
+                    # dead next-leader must not wedge the network)
+                    self.start_view_change()
                 if not self.process_pending():
                     self._stop.wait(poll_interval)
 

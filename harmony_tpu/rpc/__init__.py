@@ -1,0 +1,5 @@
+"""JSON-RPC: the external API server."""
+
+from .server import RPCServer
+
+__all__ = ["RPCServer"]

@@ -1,0 +1,301 @@
+"""JSON-RPC 2.0 server over HTTP.
+
+The role of the reference's RPC stack (reference: rpc/harmony/rpc.go:
+71-275 — HTTP/WS servers registering hmy/hmyv2/eth namespace APIs with
+a method filter and rate limiting; eth/rpc is the forked server
+internals).  Stdlib-only: a threading HTTP server dispatching
+namespace_method to the hmy facade; hmyv2 returns decimal integers
+where hmy/eth return 0x-hex (the reference's v1/v2 distinction).
+
+Method names follow the reference surface: hmy_blockNumber,
+hmy_getBalance, hmy_getBlockByNumber, hmy_sendRawTransaction,
+hmy_getValidatorInformation, eth_* aliases, net_version, web3_*.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..core.tx_pool import PoolError
+
+JSONRPC_INTERNAL = -32603
+JSONRPC_METHOD_NOT_FOUND = -32601
+JSONRPC_INVALID_PARAMS = -32602
+JSONRPC_PARSE_ERROR = -32700
+
+
+def _hex(v: int) -> str:
+    return hex(v)
+
+
+def _addr(param: str) -> bytes:
+    h = param[2:] if param.startswith("0x") else param
+    b = bytes.fromhex(h)
+    if len(b) != 20:
+        raise ValueError("address must be 20 bytes")
+    return b
+
+
+def _block_num(param, head: int) -> int:
+    if isinstance(param, str):
+        if param in ("latest", "pending", "finalized", "safe"):
+            return head
+        if param == "earliest":
+            return 0
+        return int(param, 16) if param.startswith("0x") else int(param)
+    return int(param)
+
+
+class RateLimiter:
+    """Token-bucket per client ip (reference: rpc method filter +
+    rate limiting, rpc.go:158-216)."""
+
+    def __init__(self, per_second: float = 100.0, burst: int = 200):
+        self.rate = per_second
+        self.burst = burst
+        self._state: dict = {}
+        self._lock = threading.Lock()
+
+    def allow(self, key: str) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._state.get(key, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens < 1.0:
+                self._state[key] = (tokens, now)
+                return False
+            self._state[key] = (tokens - 1.0, now)
+            return True
+
+
+class RPCServer:
+    def __init__(self, hmy, port: int = 0, method_allowlist=None,
+                 rate_limiter: RateLimiter | None = None):
+        self.hmy = hmy
+        self.allow = set(method_allowlist) if method_allowlist else None
+        self.limiter = rate_limiter or RateLimiter()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                ip = self.client_address[0]
+                if not outer.limiter.allow(ip):
+                    self.send_response(429)
+                    self.end_headers()
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                except (ValueError, KeyError):
+                    body = outer._error(None, JSONRPC_PARSE_ERROR,
+                                        "parse error")
+                    self._reply(body)
+                    return
+                if isinstance(req, list):  # batch (bounded)
+                    body = [outer.dispatch(r) for r in req[:100]]
+                else:
+                    body = outer.dispatch(req)
+                self._reply(body)
+
+            def _reply(self, body):
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- dispatch -----------------------------------------------------------
+
+    @staticmethod
+    def _error(req_id, code, message):
+        return {
+            "jsonrpc": "2.0", "id": req_id,
+            "error": {"code": code, "message": message},
+        }
+
+    def dispatch(self, req) -> dict:
+        if not isinstance(req, dict):
+            return self._error(None, -32600, "invalid request object")
+        req_id = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", [])
+        if self.allow is not None and method not in self.allow:
+            return self._error(req_id, JSONRPC_METHOD_NOT_FOUND,
+                               f"method {method} not allowed")
+        if "_" not in method:
+            return self._error(req_id, JSONRPC_METHOD_NOT_FOUND,
+                               f"malformed method {method}")
+        namespace, name = method.split("_", 1)
+        fn = getattr(self, f"_{name}", None)
+        if fn is None or namespace not in (
+            "hmy", "hmyv2", "eth", "net", "web3", "debug"
+        ):
+            return self._error(req_id, JSONRPC_METHOD_NOT_FOUND,
+                               f"method {method} not found")
+        v2 = namespace == "hmyv2"
+        try:
+            result = fn(params, v2)
+        except (ValueError, KeyError, IndexError, TypeError) as e:
+            return self._error(req_id, JSONRPC_INVALID_PARAMS, str(e))
+        except PoolError as e:
+            return self._error(req_id, JSONRPC_INTERNAL, str(e))
+        return {"jsonrpc": "2.0", "id": req_id, "result": result}
+
+    # -- methods (shared across namespaces; v2 = decimal ints) --------------
+
+    def _int(self, v: int, v2: bool):
+        return v if v2 else _hex(v)
+
+    def _blockNumber(self, params, v2):
+        return self._int(self.hmy.block_number(), v2)
+
+    def _chainId(self, params, v2):
+        return self._int(self.hmy.chain_id(), v2)
+
+    def _version(self, params, v2):  # net_version
+        return str(self.hmy.chain_id())
+
+    def _clientVersion(self, params, v2):  # web3_clientVersion
+        return "harmony-tpu/0.1"
+
+    def _shardID(self, params, v2):
+        return self.hmy.shard_id()
+
+    def _getEpoch(self, params, v2):
+        return self._int(self.hmy.current_epoch(), v2)
+
+    def _getBalance(self, params, v2):
+        addr = _addr(params[0])
+        num = None
+        if len(params) > 1:
+            num = _block_num(params[1], self.hmy.block_number())
+        return self._int(self.hmy.get_balance(addr, num), v2)
+
+    def _getTransactionCount(self, params, v2):
+        return self._int(self.hmy.get_nonce(_addr(params[0])), v2)
+
+    def _header_dict(self, h, v2):
+        return {
+            "number": self._int(h.block_num, v2),
+            "epoch": self._int(h.epoch, v2),
+            "shardID": h.shard_id,
+            "viewID": self._int(h.view_id, v2),
+            "hash": "0x" + h.hash().hex(),
+            "parentHash": "0x" + h.parent_hash.hex(),
+            "stateRoot": "0x" + h.root.hex(),
+            "transactionsRoot": "0x" + h.tx_root.hex(),
+            "timestamp": self._int(h.timestamp, v2),
+            "lastCommitSig": "0x" + h.last_commit_sig.hex(),
+            "lastCommitBitmap": "0x" + h.last_commit_bitmap.hex(),
+        }
+
+    def _tx_dict(self, tx, block_num, idx, v2):
+        chain_id = self.hmy.chain_id()
+        return {
+            "hash": "0x" + tx.hash(chain_id).hex(),
+            "nonce": self._int(tx.nonce, v2),
+            "from": "0x" + tx.sender(chain_id).hex(),
+            "to": ("0x" + tx.to.hex()) if tx.to else None,
+            "value": self._int(tx.value, v2),
+            "gas": self._int(tx.gas_limit, v2),
+            "gasPrice": self._int(tx.gas_price, v2),
+            "shardID": tx.shard_id,
+            "toShardID": tx.to_shard,
+            "blockNumber": self._int(block_num, v2),
+            "transactionIndex": self._int(idx, v2),
+            "input": "0x" + tx.data.hex(),
+        }
+
+    def _getBlockByNumber(self, params, v2):
+        num = _block_num(params[0], self.hmy.block_number())
+        full = bool(params[1]) if len(params) > 1 else False
+        block = self.hmy.block_by_number(num)
+        if block is None:
+            return None
+        out = self._header_dict(block.header, v2)
+        chain_id = self.hmy.chain_id()
+        if full:
+            out["transactions"] = [
+                self._tx_dict(tx, num, i, v2)
+                for i, tx in enumerate(block.transactions)
+            ]
+        else:
+            out["transactions"] = [
+                "0x" + tx.hash(chain_id).hex()
+                for tx in block.transactions
+            ]
+        out["stakingTransactions"] = [
+            "0x" + stx.hash(chain_id).hex()
+            for stx in block.staking_transactions
+        ]
+        return out
+
+    def _getBlockByHash(self, params, v2):
+        block = self.hmy.block_by_hash(bytes.fromhex(params[0][2:]))
+        if block is None:
+            return None
+        return self._getBlockByNumber([block.block_num, *params[1:]], v2)
+
+    def _getTransactionByHash(self, params, v2):
+        found = self.hmy.get_transaction(bytes.fromhex(params[0][2:]))
+        if found is None:
+            return None
+        num, idx, tx = found
+        return self._tx_dict(tx, num, idx, v2)
+
+    def _sendRawTransaction(self, params, v2):
+        blob = bytes.fromhex(params[0][2:] if params[0].startswith("0x")
+                             else params[0])
+        return "0x" + self.hmy.send_raw_transaction(blob).hex()
+
+    def _sendRawStakingTransaction(self, params, v2):
+        blob = bytes.fromhex(params[0][2:] if params[0].startswith("0x")
+                             else params[0])
+        return "0x" + self.hmy.send_raw_staking_transaction(blob).hex()
+
+    def _getAllValidatorAddresses(self, params, v2):
+        return ["0x" + a.hex() for a in self.hmy.validator_addresses()]
+
+    def _getValidatorInformation(self, params, v2):
+        return self.hmy.validator_information(_addr(params[0]))
+
+    def _getTotalStaking(self, params, v2):
+        return self._int(self.hmy.total_staking(), v2)
+
+    def _getCommittee(self, params, v2):
+        epoch = int(params[0]) if params else None
+        return ["0x" + k.hex() for k in self.hmy.committee(epoch)]
+
+    def _getBlockSigners(self, params, v2):
+        """Keys that signed block N (from the stored commit bitmap)."""
+        from ..staking.availability import block_signers
+
+        num = _block_num(params[0], self.hmy.block_number())
+        proof = self.hmy.read_commit_sig(num)
+        if proof is None:
+            return []
+        epoch = self.hmy.chain.epoch_of(num)
+        committee = self.hmy.committee(epoch)
+        signed, _ = block_signers(proof[96:], committee)
+        return ["0x" + k.hex() for k in signed]

@@ -1,0 +1,68 @@
+"""Node binary wiring: build_node brings up chain + consensus + RPC +
+metrics + sync server from config (the reference's cmd/harmony
+setupNodeAndRun path — SURVEY.md §3.1 — in one process)."""
+
+import http.client
+import json
+import time
+
+from harmony_tpu.cli import DEFAULTS, build_node, load_config
+
+
+def _rpc(port, method, params=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(
+        "POST", "/",
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                    "params": params or []}),
+        {"Content-Type": "application/json"},
+    )
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out
+
+
+def test_build_node_full_stack(tmp_path):
+    cfg = load_config(None, {})
+    cfg.update(
+        datadir=str(tmp_path), in_memory=True, rpc_port=0,
+        metrics_port=0, p2p_port=0, sync_port=0, blocks_per_epoch=16,
+    )
+    node, manager, reg, rpc, metrics = build_node(cfg)
+    manager.start_services()
+    try:
+        # the dev node holds the whole committee: blocks flow solo.
+        # Generous deadline: each block needs ~4 host pairings and this
+        # box has one core that background compiles may contend for.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if node.chain.head_number >= 2:
+                break
+            time.sleep(0.05)
+        assert node.chain.head_number >= 2
+
+        head = _rpc(rpc.port, "hmyv2_blockNumber")["result"]
+        assert head >= 2
+        block1 = _rpc(rpc.port, "hmy_getBlockByNumber", ["0x1", False])
+        assert block1["result"]["number"] == "0x1"
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", metrics.port, timeout=10
+        )
+        conn.request("GET", "/metrics")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        manager.stop_services()
+
+
+def test_load_config_toml_and_overrides(tmp_path):
+    cfg_file = tmp_path / "node.toml"
+    cfg_file.write_text(
+        'network = "testnet"\nshard_id = 3\nrpc_port = 1234\n'
+    )
+    cfg = load_config(str(cfg_file), {"rpc_port": 4321})
+    assert cfg["network"] == "testnet"
+    assert cfg["shard_id"] == 3
+    assert cfg["rpc_port"] == 4321  # flag beats file
+    assert cfg["datadir"] == DEFAULTS["datadir"]

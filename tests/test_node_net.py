@@ -244,3 +244,59 @@ def test_single_node_committee_self_quorum():
     node.start_round_if_leader()
     assert node.chain.head_number == 2
     assert node.chain.read_commit_sig(1) is not None
+
+
+# -- view change ------------------------------------------------------------
+
+def test_view_change_replaces_failed_leader():
+    """Leader partitioned before proposing: validators time out, view-
+    change to the next leader, and commit a fresh block (M2/NIL path)."""
+    nodes, _, net = _make_localnet(4)
+    leader = next(n for n in nodes if n.is_leader)
+    net.partitioned.add(leader.host.name)
+    live = [n for n in nodes if n is not leader]
+    for n in live:
+        n.start_view_change()
+    _pump(nodes)
+    new_leader = next(n for n in live if n.is_leader)
+    assert new_leader is not leader
+    new_leader.start_round_if_leader()
+    _pump(nodes)
+    assert all(n.chain.head_number == 1 for n in live)
+    assert leader.chain.head_number == 0
+    assert all(not n.in_view_change for n in live)
+
+
+def test_view_change_carries_prepared_block():
+    """Leader dies AFTER broadcasting PREPARED: the view change carries
+    the prepared block (M1) and the new leader re-proposes THE SAME
+    block — same hash, original header view — which then commits."""
+    nodes, _, net = _make_localnet(4)
+    leader = next(n for n in nodes if n.is_leader)
+    validators = [n for n in nodes if n is not leader]
+
+    proposed = leader.start_round_if_leader()
+    # validators vote prepare
+    for v in validators:
+        v.process_pending()
+    # leader reaches prepare quorum and broadcasts PREPARED...
+    leader.process_pending(max_msgs=2)
+    # ...validators receive it and send commit votes...
+    for v in validators:
+        v.process_pending()
+    # ...then the leader vanishes before COMMITTED
+    net.partitioned.add(leader.host.name)
+    assert all(v._prepared_proof is not None for v in validators)
+
+    for v in validators:
+        v.start_view_change()
+    _pump(nodes)
+    new_leader = next(v for v in validators if v.is_leader)
+    assert new_leader._reproposal is not None or new_leader._proposed
+    new_leader.start_round_if_leader()
+    _pump(nodes)
+    assert all(v.chain.head_number == 1 for v in validators)
+    committed = validators[0].chain.block_by_number(1)
+    # the SAME block survived: same hash, original proposal view
+    assert committed.hash() == proposed.hash()
+    assert committed.header.view_id == proposed.header.view_id

@@ -1,0 +1,152 @@
+"""hmy facade + JSON-RPC server + metrics exposition (the reference's
+L7 API surface — SURVEY.md §2.6 rpc/harmony + prometheus)."""
+
+import http.client
+import json
+
+import pytest
+
+from harmony_tpu.core import rawdb
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.core.types import Transaction
+from harmony_tpu.hmy import Harmony
+from harmony_tpu.metrics import MetricsServer, Registry
+from harmony_tpu.node.worker import Worker
+from harmony_tpu.rpc import RPCServer
+
+CHAIN_ID = 2
+
+
+@pytest.fixture(scope="module")
+def stack():
+    genesis, keys, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    worker = Worker(chain, pool)
+    to = b"\x09" * 20
+    tx = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=0,
+        to=to, value=5555,
+    ).sign(keys[0], CHAIN_ID)
+    pool.add(tx)
+    block = worker.propose_block(view_id=1)
+    chain.insert_chain([block], verify_seals=False)
+    pool.drop_applied()
+    hmy = Harmony(chain, pool)
+    srv = RPCServer(hmy, port=0).start()
+    yield srv, hmy, keys, to, tx
+    srv.stop()
+
+
+def _call(port, method, params=None, req_id=1):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(
+        "POST", "/",
+        json.dumps({"jsonrpc": "2.0", "id": req_id, "method": method,
+                    "params": params or []}),
+        {"Content-Type": "application/json"},
+    )
+    resp = json.loads(conn.getresponse().read())
+    conn.close()
+    return resp
+
+
+def test_rpc_block_and_balance(stack):
+    srv, hmy, keys, to, tx = stack
+    assert _call(srv.port, "hmy_blockNumber")["result"] == "0x1"
+    assert _call(srv.port, "hmyv2_blockNumber")["result"] == 1
+    bal = _call(srv.port, "hmyv2_getBalance", ["0x" + to.hex()])
+    assert bal["result"] == 5555
+    block = _call(srv.port, "hmy_getBlockByNumber", ["0x1", True])["result"]
+    assert block["number"] == "0x1"
+    assert len(block["transactions"]) == 1
+    assert block["transactions"][0]["value"] == hex(5555)
+    assert block["transactions"][0]["from"] == "0x" + keys[0].address().hex()
+    by_hash = _call(srv.port, "hmy_getBlockByHash", [block["hash"]])
+    assert by_hash["result"]["number"] == "0x1"
+    found = _call(srv.port, "hmy_getTransactionByHash",
+                  ["0x" + tx.hash(CHAIN_ID).hex()])["result"]
+    assert found["blockNumber"] == "0x1"
+    assert _call(srv.port, "net_version")["result"] == str(CHAIN_ID)
+
+
+def test_rpc_send_raw_transaction(stack):
+    srv, hmy, keys, to, _ = stack
+    tx2 = Transaction(
+        nonce=1, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=0,
+        to=to, value=1,
+    ).sign(keys[0], CHAIN_ID)
+    blob = rawdb.encode_tx(tx2, CHAIN_ID)
+    resp = _call(srv.port, "hmy_sendRawTransaction", ["0x" + blob.hex()])
+    assert resp["result"] == "0x" + tx2.hash(CHAIN_ID).hex()
+    assert len(hmy.tx_pool) == 1
+    # a bad signature is an error, not a silent accept
+    bad = bytearray(blob)
+    bad[-10] ^= 0xFF
+    resp = _call(srv.port, "hmy_sendRawTransaction", ["0x" + bad.hex()])
+    assert "error" in resp
+
+
+def test_rpc_errors_and_committee(stack):
+    srv, hmy, keys, _, _ = stack
+    assert "error" in _call(srv.port, "hmy_noSuchMethod")
+    assert "error" in _call(srv.port, "nonsense")
+    committee = _call(srv.port, "hmy_getCommittee")["result"]
+    assert len(committee) == 4
+    # batch requests
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    batch = [
+        {"jsonrpc": "2.0", "id": i, "method": "hmy_blockNumber",
+         "params": []}
+        for i in range(3)
+    ]
+    conn.request("POST", "/", json.dumps(batch),
+                 {"Content-Type": "application/json"})
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    assert [r["result"] for r in out] == ["0x1"] * 3
+
+
+def test_method_allowlist():
+    genesis, _, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    srv = RPCServer(Harmony(chain), port=0,
+                    method_allowlist=["hmy_blockNumber"]).start()
+    try:
+        assert _call(srv.port, "hmy_blockNumber")["result"] == "0x0"
+        assert "error" in _call(srv.port, "hmy_getCommittee")
+    finally:
+        srv.stop()
+
+
+def test_metrics_registry_and_server():
+    reg = Registry()
+    c = reg.counter("consensus_rounds_total", "rounds")
+    c.inc(phase="prepare")
+    c.inc(phase="prepare")
+    c.inc(phase="commit")
+    g = reg.gauge("chain_head", "head")
+    g.set(42)
+    h = reg.histogram("verify_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 'consensus_rounds_total{phase="prepare"} 2' in text
+    assert "chain_head 42" in text
+    assert 'verify_seconds_bucket{le="0.1"} 1' in text
+    assert 'verify_seconds_bucket{le="+Inf"} 3' in text
+    assert "verify_seconds_count 3" in text
+
+    srv = MetricsServer(reg, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        conn.close()
+        assert "chain_head 42" in body
+    finally:
+        srv.stop()
