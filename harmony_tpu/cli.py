@@ -82,11 +82,24 @@ def build_node(cfg: dict):
     else:
         genesis, _, dev_bls = dev_genesis(shard_id=cfg["shard_id"])
 
-    db = (
-        MemKV() if cfg["in_memory"]
-        else FileKV(os.path.join(cfg["datadir"],
-                                 f"shard{cfg['shard_id']}.db"))
-    )
+    if cfg["in_memory"]:
+        db = MemKV()
+    else:
+        db_path = os.path.join(cfg["datadir"],
+                               f"shard{cfg['shard_id']}.db")
+        db = None
+        if cfg.get("native_kv", True):
+            # ANY native failure (missing toolchain, corrupt file ->
+            # kv_open nullptr, ...) falls back to the Python twin —
+            # same on-disk format, so the fallback opens the same DB
+            try:
+                from .core.kv_native import NativeKV
+
+                db = NativeKV(db_path)
+            except Exception:
+                db = None
+        if db is None:
+            db = FileKV(db_path)
     chain = Blockchain(db, genesis,
                        blocks_per_epoch=cfg["blocks_per_epoch"])
     pool = TxPool(genesis.config.chain_id, cfg["shard_id"], chain.state)
@@ -169,8 +182,30 @@ def main(argv=None):
     p.add_argument("--sync-port", type=int, dest="sync_port")
     p.add_argument("--peer", action="append", dest="peers")
     p.add_argument("--sync-peer", action="append", dest="sync_peers")
+    p.add_argument("--no-native-kv", action="store_const", const=False,
+                   default=None, dest="native_kv")
+    p.add_argument("--skip-ntp-check", action="store_const", const=False,
+                   default=None, dest="ntp_check")
     args = p.parse_args(argv)
     cfg = load_config(args.config, vars(args))
+
+    # clock sanity before consensus (reference: common/ntp at startup):
+    # refuse on MEASURED excessive drift; unreachable NTP only warns
+    if cfg.get("ntp_check", True):
+        from .ntp import check_clock
+
+        ok, offset = check_clock()
+        if not ok:
+            print(
+                f"FATAL: local clock drifts {offset:+.1f}s from NTP — "
+                "a validator this far off misses view windows "
+                "(--skip-ntp-check to override)",
+                flush=True,
+            )
+            return 1
+        if offset is None:
+            print("warning: NTP unreachable, clock check skipped",
+                  flush=True)
 
     node, manager, reg, rpc, metrics = build_node(cfg)
     manager.start_services()

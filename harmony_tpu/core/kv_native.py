@@ -1,0 +1,131 @@
+"""ctypes binding for the native C++ KV store (native/kvstore.cpp).
+
+Same interface as kv.FileKV and the SAME on-disk format — a chain
+written by one opens under the other.  The native store is the
+deployment IO path (the role LevelDB's C++ plays under the reference's
+core/rawdb); FileKV stays the dependency-free fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))),
+    "native", "libharmony_kv.so",
+)
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_put.restype = ctypes.c_int
+    lib.kv_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.kv_get.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.kv_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.kv_delete.restype = ctypes.c_int
+    lib.kv_delete.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.kv_has.restype = ctypes.c_int
+    lib.kv_has.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.kv_len.restype = ctypes.c_uint64
+    lib.kv_len.argtypes = [ctypes.c_void_p]
+    lib.kv_flush.restype = ctypes.c_int
+    lib.kv_flush.argtypes = [ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_close.restype = None
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def build_native():
+    """Compile the shared library (g++ is in the image)."""
+    native_dir = os.path.dirname(_LIB_PATH)
+    subprocess.run(
+        ["make", "-C", native_dir, "libharmony_kv.so"],
+        check=True, capture_output=True,
+    )
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class NativeKV:
+    """Drop-in for kv.FileKV backed by the C++ store."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.kv_open(path.encode())
+        if not self._h:
+            raise OSError(f"kv_open failed for {path}")
+        self.path = path
+
+    def get(self, key: bytes):
+        vlen = ctypes.c_uint32(0)
+        ptr = self._lib.kv_get(
+            self._h, key, len(key), ctypes.byref(vlen)
+        )
+        if not ptr:
+            return None
+        return ctypes.string_at(ptr, vlen.value)
+
+    def put(self, key: bytes, value: bytes):
+        if self._lib.kv_put(self._h, key, len(key), value,
+                            len(value)) != 0:
+            raise OSError("kv_put failed")
+
+    def delete(self, key: bytes):
+        if self._lib.kv_delete(self._h, key, len(key)) != 0:
+            raise OSError("kv_delete failed")
+
+    def has(self, key: bytes) -> bool:
+        return bool(self._lib.kv_has(self._h, key, len(key)))
+
+    def flush(self):
+        self._lib.kv_flush(self._h)
+
+    def compact(self):
+        if self._lib.kv_compact(self._h) != 0:
+            raise OSError("kv_compact failed")
+
+    def close(self):
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+    def __len__(self):
+        return int(self._lib.kv_len(self._h))
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

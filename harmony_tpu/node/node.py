@@ -75,6 +75,8 @@ class Node:
         self._stop = threading.Event()
         self.committed_blocks = 0
         self.dropped_messages = 0
+        self.webhooks = registry.get("webhooks")
+        self.pending_double_signs: list = []  # evidence for proposals
         self._vc = 0  # view changes since last commit
         self.in_view_change = False
         self.phase_timeout = 27.0  # reference: consensus/config.go:10
@@ -345,10 +347,66 @@ class Node:
                 self._broadcast(committed, retry=True)
                 self._commit_block(committed)
 
+    def _check_double_sign(self, msg: FBFTMessage, store, payload_for):
+        """Leader-side equivocation detection (reference:
+        consensus/double_sign.go:16 checkDoubleSign).  Evidence needs
+        BOTH signed votes from the same key THIS round: the stored vote
+        for the announced block plus a verified conflicting vote for a
+        different hash at the same (height, view) — a delayed vote from
+        another view, or unsigned junk, must not frame anyone."""
+        if (
+            self.leader.current_block_hash is None
+            or msg.block_hash == self.leader.current_block_hash
+            or msg.view_id != self.view_id
+            or msg.block_num != self.block_num
+            or not msg.sender_pubkeys
+        ):
+            return
+        # the accused keys must have already cast the round's vote
+        first = None  # (keyset, stored aggregate signature)
+        for keyset, sig in store.items():
+            if any(pk in keyset for pk in msg.sender_pubkeys):
+                first = (keyset, sig)
+                break
+        if first is None:
+            return
+        from .. import bls as B
+
+        if not B.verify_aggregate_bytes(
+            msg.sender_pubkeys, payload_for(msg.block_hash), msg.payload
+        ):
+            return
+        evidence = {
+            "height": msg.block_num,
+            "view_id": msg.view_id,
+            "shard_id": self.chain.shard_id,
+            "keys": [pk.hex() for pk in msg.sender_pubkeys],
+            "first_hash": self.leader.current_block_hash.hex(),
+            "first_keys": [pk.hex() for pk in first[0]],
+            "first_signature": first[1].bytes.hex(),
+            "second_hash": msg.block_hash.hex(),
+            "second_signature": msg.payload.hex(),
+        }
+        if len(self.pending_double_signs) < 64:
+            self.pending_double_signs.append(evidence)
+        if self.webhooks is not None:
+            self.webhooks.fire("double_sign", evidence)
+
+    def drain_double_signs(self) -> list:
+        """Hand collected evidence to the slash pipeline (proposal
+        inclusion / operator tooling) and clear the queue."""
+        out, self.pending_double_signs = self.pending_double_signs, []
+        return out
+
     def _on_prepare(self, msg: FBFTMessage):
         if not self.is_leader:
             return
-        self.leader.on_prepare(msg)
+        if not self.leader.on_prepare(msg):
+            from ..consensus.signature import prepare_payload
+
+            self._check_double_sign(
+                msg, self.leader.prepare_sigs, prepare_payload
+            )
         self._leader_advance()
 
     def _on_prepared(self, msg: FBFTMessage):
@@ -370,7 +428,10 @@ class Node:
     def _on_commit(self, msg: FBFTMessage):
         if not self.is_leader:
             return
-        self.leader.on_commit(msg)
+        if not self.leader.on_commit(msg):
+            self._check_double_sign(
+                msg, self.leader.commit_sigs, self.leader._commit_payload
+            )
         self._leader_advance()
 
     def _on_committed(self, msg: FBFTMessage):

@@ -1,0 +1,63 @@
+"""Webhooks: operator alerting on consensus incidents.
+
+The role of the reference's webhooks package (reference:
+webhooks/yaml.go — a yaml-configured double-sign report hook, called
+from the Registry's webHooks when checkDoubleSign trips —
+consensus/double_sign.go:16-135).  Hooks are plain callables here
+(HTTP POST delivery is one such callable); the node fires them from
+the double-sign detector and on view changes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from collections import deque
+
+
+class Hooks:
+    """Named event -> list of callables(payload dict)."""
+
+    EVENTS = ("double_sign", "view_change", "block_committed")
+
+    def __init__(self, log_size: int = 256):
+        self._hooks: dict[str, list] = {e: [] for e in self.EVENTS}
+        # bounded recent-event log for tests/ops (a hot event stream
+        # must not grow node memory without bound)
+        self.fired: deque = deque(maxlen=log_size)
+
+    def register(self, event: str, fn):
+        if event not in self._hooks:
+            raise ValueError(f"unknown webhook event {event!r}")
+        self._hooks[event].append(fn)
+
+    def fire(self, event: str, payload: dict):
+        """Never raises: a broken hook must not break consensus."""
+        self.fired.append((event, payload))
+        for fn in self._hooks.get(event, ()):
+            try:
+                fn(payload)
+            except Exception:
+                pass
+
+
+def http_post_hook(url: str, timeout: float = 5.0):
+    """A hook that POSTs the payload as JSON (fire-and-forget thread —
+    the reference's report hook is likewise non-blocking)."""
+
+    def hook(payload: dict):
+        def send():
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=timeout).close()
+            except OSError:
+                pass
+
+        threading.Thread(target=send, daemon=True).start()
+
+    return hook
