@@ -1,0 +1,97 @@
+"""Cross-shard receipt routing: source-shard export -> destination
+inclusion.
+
+The role of the reference's cross-shard plumbing (reference:
+node/harmony/node_cross_shard.go — BroadcastCXReceipts after commit,
+ProcessReceiptMessage on the destination; core/state_processor
+ApplyIncomingReceipt): after a block commits on its shard, its
+outgoing CXReceipts (grouped per destination at insert —
+core/rawdb write_outgoing_cx) are delivered to the destination
+shard, whose proposer includes them as the next block's
+incoming_receipts.  Delivery here is any byte transport (gossip topic
+per shard in deployment; direct handoff in-process); the receipt
+payload's integrity is re-checked on inclusion via the tx_root
+commitment over incoming receipts.
+"""
+
+from __future__ import annotations
+
+from ..core import rawdb
+from ..core.types import Reader as _Reader
+from ..core.types import _enc_bytes, _enc_int
+from ..p2p.groups import GroupID
+
+
+def cx_topic(network: str, to_shard: int) -> str:
+    """Destination-shard receipt topic (reference: group per shard)."""
+    return GroupID(network, to_shard, "cx").topic()
+
+
+def encode_cx_batch(from_shard: int, block_num: int, cxs: list) -> bytes:
+    out = bytearray()
+    out += _enc_int(from_shard, 4) + _enc_int(block_num)
+    out += _enc_int(len(cxs), 4)
+    for cx in cxs:
+        out += _enc_bytes(rawdb.encode_cx(cx))
+    return bytes(out)
+
+
+def decode_cx_batch(data: bytes):
+    r = _Reader(data)
+    from_shard = r.int_(4)
+    block_num = r.int_()
+    cxs = [rawdb.decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    return from_shard, block_num, cxs
+
+
+def export_receipts(chain, block_num: int, shard_count: int) -> dict:
+    """Outgoing receipts of a committed block, grouped by destination
+    (the source node broadcasts each group to its shard's topic)."""
+    out = {}
+    for to_shard in range(shard_count):
+        if to_shard == chain.shard_id:
+            continue
+        cxs = chain.outgoing_cx(to_shard, block_num)
+        if cxs:
+            out[to_shard] = cxs
+    return out
+
+
+class CXPool:
+    """Destination-side pending incoming receipts (the role of the
+    reference's pending CXReceipts store on the node): deduplicated by
+    (from_shard, block_num), drained into the next proposal."""
+
+    def __init__(self, shard_id: int, cap: int = 4096):
+        self.shard_id = shard_id
+        self.cap = cap
+        self._pending: dict = {}  # (from_shard, block_num) -> [CXReceipt]
+
+    def add_batch(self, data: bytes) -> int:
+        """Ingest an encoded batch; returns receipts accepted."""
+        from_shard, block_num, cxs = decode_cx_batch(data)
+        key = (from_shard, block_num)
+        if key in self._pending:
+            return 0
+        good = [cx for cx in cxs if cx.to_shard == self.shard_id]
+        if not good:
+            return 0
+        total = sum(len(v) for v in self._pending.values())
+        if total + len(good) > self.cap:
+            return 0
+        self._pending[key] = good
+        return len(good)
+
+    def drain(self, max_receipts: int = 512) -> list:
+        """Receipts for the next proposal, oldest source blocks first."""
+        out = []
+        for key in sorted(self._pending):
+            batch = self._pending[key]
+            if len(out) + len(batch) > max_receipts:
+                break
+            out.extend(batch)
+            del self._pending[key]
+        return out
+
+    def __len__(self):
+        return sum(len(v) for v in self._pending.values())

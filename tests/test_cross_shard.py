@@ -1,0 +1,108 @@
+"""Cross-shard transfers end to end: debit on the source shard, receipt
+export, destination inclusion, credit (the reference's CXReceipt flow
+— SURVEY.md §2.7 cross-shard traffic)."""
+
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import Genesis, dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.core.types import Transaction
+from harmony_tpu.node.cross_shard import (
+    CXPool,
+    cx_topic,
+    decode_cx_batch,
+    encode_cx_batch,
+    export_receipts,
+)
+from harmony_tpu.node.worker import Worker
+
+CHAIN_ID = 2
+
+
+def _two_shards():
+    g0, ecdsa_keys, bls = dev_genesis(shard_id=0)
+    g1 = Genesis(
+        config=g0.config, shard_id=1, alloc=dict(g0.alloc),
+        committee=list(g0.committee),
+    )
+    c0 = Blockchain(MemKV(), g0, blocks_per_epoch=16)
+    c1 = Blockchain(MemKV(), g1, blocks_per_epoch=16)
+    return c0, c1, ecdsa_keys
+
+
+def test_cross_shard_transfer_end_to_end():
+    c0, c1, keys = _two_shards()
+    sender = keys[0]
+    to = b"\x0c" * 20
+    pool0 = TxPool(CHAIN_ID, 0, c0.state)
+    tx = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=1,
+        to=to, value=9999,
+    ).sign(sender, CHAIN_ID)
+    pool0.add(tx)
+
+    # source shard commits the debit and exports the receipt
+    block0 = Worker(c0, pool0).propose_block(view_id=1)
+    assert c0.insert_chain([block0], verify_seals=False) == 1
+    sender_bal = c0.state().balance(sender.address())
+    assert c0.state().balance(to) == 0  # no local credit
+    groups = export_receipts(c0, 1, shard_count=2)
+    assert list(groups) == [1] and groups[1][0].amount == 9999
+
+    # transport: encode -> (gossip topic) -> decode at destination
+    blob = encode_cx_batch(0, 1, groups[1])
+    assert cx_topic("localnet", 1).endswith("/1/cx")
+    cx_pool = CXPool(shard_id=1)
+    assert cx_pool.add_batch(blob) == 1
+    assert cx_pool.add_batch(blob) == 0  # duplicate batch dropped
+
+    # destination proposer includes the receipts; credit lands
+    incoming = cx_pool.drain()
+    block1 = Worker(c1, None).propose_block(
+        view_id=1, incoming_receipts=incoming
+    )
+    assert block1.incoming_receipts
+    assert c1.insert_chain([block1], verify_seals=False) == 1
+    assert c1.state().balance(to) == 9999
+    assert len(cx_pool) == 0
+
+    # replay integrity: tampering with an included receipt breaks the
+    # body commitment (tx_root covers incoming receipts)
+    import pytest
+
+    from harmony_tpu.core.blockchain import ChainError
+
+    c1b = Blockchain(MemKV(), Genesis(
+        config=c1.config, shard_id=1,
+        alloc=dict(c1.genesis.alloc), committee=list(c1.genesis.committee),
+    ), blocks_per_epoch=16)
+    tampered = Worker(c1b, None).propose_block(
+        view_id=1, incoming_receipts=incoming
+    )
+    tampered.incoming_receipts[0].amount = 10**18
+    with pytest.raises(ChainError):
+        c1b.insert_chain([tampered], verify_seals=False)
+
+
+def test_cx_pool_caps_and_filtering():
+    cx_pool = CXPool(shard_id=1, cap=2)
+    from harmony_tpu.core.types import CXReceipt
+
+    def batch(from_shard, num, n, to_shard=1):
+        cxs = [
+            CXReceipt(
+                tx_hash=bytes([i]) * 32, sender=b"\x01" * 20,
+                to=b"\x02" * 20, amount=i + 1, from_shard=from_shard,
+                to_shard=to_shard, block_num=num,
+            )
+            for i in range(n)
+        ]
+        return encode_cx_batch(from_shard, num, cxs)
+
+    # wrong destination filtered out entirely
+    assert cx_pool.add_batch(batch(0, 1, 1, to_shard=3)) == 0
+    assert cx_pool.add_batch(batch(0, 2, 2)) == 2
+    # cap reached
+    assert cx_pool.add_batch(batch(2, 3, 1)) == 0
+    assert len(cx_pool.drain()) == 2
+    assert cx_pool.add_batch(batch(2, 3, 1)) == 1
