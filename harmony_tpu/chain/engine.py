@@ -83,11 +83,21 @@ def bucket_size(n: int) -> int:
 class Engine:
     """Header signature verification with epoch-ctx + verified-sig caches."""
 
-    def __init__(self, committee_provider, sig_cache_size: int = 4096):
-        """committee_provider(shard_id, epoch) -> EpochContext."""
+    def __init__(self, committee_provider, sig_cache_size: int = 4096,
+                 device: bool = True):
+        """committee_provider(shard_id, epoch) -> EpochContext.
+
+        ``device=False`` routes batch verification through the host
+        bigint path instead of the TPU ops: for CPU-only test
+        environments where XLA's persistent-cache/compile machinery is
+        unreliable (this image aborts deserializing the big pairing
+        executables — see tests/conftest.py).  Device-path correctness
+        is covered by the ops parity suite; deployment default stays
+        device=True."""
         self._provider = committee_provider
         self._epoch_ctx: dict = {}
         self._verified = _LRU(sig_cache_size)
+        self.device = device
 
     def epoch_context(self, shard_id: int, epoch: int) -> EpochContext:
         key = (shard_id, epoch)
@@ -194,6 +204,13 @@ class Engine:
             payload = self._commit_payload(header, flags[idx])
             h_pt = hash_to_g2(payload)
             survivors.append((idx, agg_pk, h_pt, sig))
+        if not self.device:
+            for idx, agg_pk, h_pt, sig in survivors:
+                if RB.verify_hashed(agg_pk, h_pt, sig):
+                    results[idx] = True
+                    header, sig_bytes, bitmap = items[idx]
+                    self._verified.put((header.hash(), sig_bytes, bitmap))
+            return results
         for chunk_start in range(0, len(survivors), VERIFY_BUCKETS[-1]):
             chunk = survivors[chunk_start:chunk_start + VERIFY_BUCKETS[-1]]
             n, padded = len(chunk), bucket_size(len(chunk))

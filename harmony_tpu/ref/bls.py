@@ -80,6 +80,15 @@ def aggregate_pubkeys(pks):
     return acc
 
 
+def verify_hashed(pk, h_point, sig) -> bool:
+    """verify() for a message already mapped to G2 (callers that hash
+    once and verify many — the engine's batch replay path)."""
+    if pk is None or sig is None:
+        return False
+    gt = multi_pairing([(g1.neg(G1_GEN), sig), (pk, h_point)])
+    return gt == F.FP12_ONE
+
+
 def verify_aggregate(pks, msg_hash: bytes, agg_sig) -> bool:
     """Aggregate verify for one message: the FBFT quorum check
     (reference: consensus/validator.go:228, internal/chain/engine.go:640)."""

