@@ -86,8 +86,13 @@ def test_sender_and_bitmap_checks():
 
 
 def test_viewchange_gating():
-    vc = _msg(msg_type=MsgType.VIEWCHANGE, view_id=101)
-    assert not validate_consensus_message(vc, _ctx(), 2).accepted
+    # a FUTURE view's VC traffic is admissible even before this node's
+    # own timeout (peers' clocks lead ours — the node buffers it);
+    # stale views are dropped unless already in view change
+    future = _msg(msg_type=MsgType.VIEWCHANGE, view_id=101)
+    assert validate_consensus_message(future, _ctx(), 2).accepted
+    stale = _msg(msg_type=MsgType.VIEWCHANGE, view_id=100)
+    assert not validate_consensus_message(stale, _ctx(), 2).accepted
     assert validate_consensus_message(
-        vc, _ctx(in_view_change=True, is_leader=False), 2
+        stale, _ctx(in_view_change=True, is_leader=False), 2
     ).accepted
