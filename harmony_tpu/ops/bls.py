@@ -17,6 +17,7 @@ host-side map-to-field (ref/hash_to_curve.py — branchy SHA work stays on
 host per SURVEY.md §7.2).  All functions are jittable with static shapes.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,9 +37,13 @@ _NEG_G1_GEN_AFF = None  # lazily built (x, -y) of the G1 generator
 def _neg_g1_gen_aff():
     global _NEG_G1_GEN_AFF
     if _NEG_G1_GEN_AFF is None:
-        x = CV.G1_GEN[0]
-        y = fp.neg(CV.G1_GEN[1])
-        _NEG_G1_GEN_AFF = jnp.stack([x, y])
+        # force concrete evaluation: a first call from INSIDE a trace
+        # (e.g. under shard_map) must not cache a tracer into the
+        # module global — that leaks into every later program
+        with jax.ensure_compile_time_eval():
+            x = CV.G1_GEN[0]
+            y = fp.neg(CV.G1_GEN[1])
+            _NEG_G1_GEN_AFF = jnp.stack([x, y])
     return _NEG_G1_GEN_AFF
 
 
