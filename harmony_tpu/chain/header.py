@@ -28,6 +28,11 @@ class Header:
     parent_hash: bytes = bytes(32)
     root: bytes = bytes(32)  # state root
     tx_root: bytes = bytes(32)  # body commitment (ordered tx hashes)
+    # outgoing cross-shard receipt commitment: keccak over the sorted
+    # (destination shard, group root) pairs (reference:
+    # block/header OutgoingReceiptHash, core/types/cx_receipt.go
+    # CXMerkleProof) — what destination shards verify CX proofs against
+    out_cx_root: bytes = bytes(32)
     timestamp: int = 0
     # parent's quorum proof: [96B agg sig || bitmap]
     last_commit_sig: bytes = b""
@@ -44,7 +49,8 @@ class Header:
         for v in (self.shard_id, self.block_num, self.epoch, self.view_id,
                   self.timestamp):
             out += v.to_bytes(8, "little")
-        for b in (self.parent_hash, self.root, self.tx_root):
+        for b in (self.parent_hash, self.root, self.tx_root,
+                  self.out_cx_root):
             if len(b) != 32:
                 raise ValueError("hash fields must be 32 bytes")
             out += b

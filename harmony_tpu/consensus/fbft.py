@@ -32,6 +32,7 @@ from .messages import (
     MsgType,
     decode_sig_and_bitmap,
     encode_sig_and_bitmap,
+    sign_message,
 )
 from .quorum import Ballot, Decider, Phase
 from .signature import construct_commit_payload, prepare_payload
@@ -88,14 +89,14 @@ class Leader(_Node):
         self.current_block_hash: bytes | None = None
 
     def announce(self, block_hash: bytes, block_bytes: bytes) -> FBFTMessage:
-        msg = FBFTMessage(
+        msg = sign_message(FBFTMessage(
             msg_type=MsgType.ANNOUNCE,
             view_id=self.cfg.view_id,
             block_num=self.cfg.block_num,
             block_hash=block_hash,
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             block=block_bytes,
-        )
+        ), self.keys)
         self.log.add_message(msg)
         self.log.add_block(block_hash, block_bytes)
         self.current_block_hash = block_hash
@@ -190,7 +191,7 @@ class Leader(_Node):
             return None
         if not self.decider.is_quorum_achieved(Phase.PREPARE):
             return None
-        return FBFTMessage(
+        return sign_message(FBFTMessage(
             msg_type=MsgType.PREPARED,
             view_id=self.cfg.view_id,
             block_num=self.cfg.block_num,
@@ -198,21 +199,21 @@ class Leader(_Node):
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=self._quorum_proof(Phase.PREPARE, self.prepare_sigs),
             block=self.log.get_block(block_hash) or b"",
-        )
+        ), self.keys)
 
     def try_committed(self, block_hash: bytes):
         if block_hash != self.current_block_hash:
             return None
         if not self.decider.is_quorum_achieved(Phase.COMMIT):
             return None
-        return FBFTMessage(
+        return sign_message(FBFTMessage(
             msg_type=MsgType.COMMITTED,
             view_id=self.cfg.view_id,
             block_num=self.cfg.block_num,
             block_hash=block_hash,
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=self._quorum_proof(Phase.COMMIT, self.commit_sigs),
-        )
+        ), self.keys)
 
 
 class Validator(_Node):
@@ -224,14 +225,14 @@ class Validator(_Node):
         (reference: consensus/validator.go:144-165 + construct.go:99-105)."""
         self.log.add_message(msg)
         sig = self.keys.sign_hash_aggregated(prepare_payload(msg.block_hash))
-        return FBFTMessage(
+        return sign_message(FBFTMessage(
             msg_type=MsgType.PREPARE,
             view_id=msg.view_id,
             block_num=msg.block_num,
             block_hash=msg.block_hash,
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=sig.bytes,
-        )
+        ), self.keys)
 
     def _verify_proof(self, msg: FBFTMessage, payload: bytes) -> bool:
         """Decode [sig || bitmap], check quorum-by-mask, verify the
@@ -262,14 +263,14 @@ class Validator(_Node):
         sig = self.keys.sign_hash_aggregated(
             self._commit_payload(msg.block_hash)
         )
-        return FBFTMessage(
+        return sign_message(FBFTMessage(
             msg_type=MsgType.COMMIT,
             view_id=msg.view_id,
             block_num=msg.block_num,
             block_hash=msg.block_hash,
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=sig.bytes,
-        )
+        ), self.keys)
 
     def on_committed(self, msg: FBFTMessage) -> bool:
         """Final check before accepting the block (validator.go:299-377)."""

@@ -45,6 +45,13 @@ class FBFTMessage:
     sender_pubkeys: list = field(default_factory=list)  # serialized 48B keys
     payload: bytes = b""  # phase signature or [agg sig || bitmap]
     block: bytes = b""  # RLP-ish block bytes (ANNOUNCE/PREPARED)
+    # BLS signature by the SENDER key(s) over the whole message
+    # (keccak of the signable encoding) — the reference signs every
+    # consensus message and verifies it on receipt
+    # (consensus/construct.go signMessage + consensus/checks.go
+    # senderKeySanityChecks/verify); without it any peer could
+    # impersonate the leader's ANNOUNCE/PREPARED/COMMITTED
+    sender_sig: bytes = b""
 
     def key(self):
         """Dedup/storage key (reference: consensus/fbft_log.go:128-143)."""
@@ -127,10 +134,10 @@ class FBFTLog:
 
 # -- wire codec --------------------------------------------------------------
 
-def encode_message(msg: FBFTMessage) -> bytes:
-    """Canonical wire form (the payload inside the gossip envelope —
-    the reference uses protobuf harmonymessage.pb.go; this framework
-    uses its fixed little-endian layout)."""
+def signable_bytes(msg: FBFTMessage) -> bytes:
+    """Every field EXCEPT the sender signature — what the sender key
+    signs (reference: consensus/construct.go signMessage signs the
+    marshaled message)."""
     out = bytearray()
     out += bytes([int(msg.msg_type)])
     out += msg.view_id.to_bytes(8, "little")
@@ -145,6 +152,44 @@ def encode_message(msg: FBFTMessage) -> bytes:
         out += pk
     out += len(msg.payload).to_bytes(4, "little") + msg.payload
     out += len(msg.block).to_bytes(4, "little") + msg.block
+    return bytes(out)
+
+
+def sign_message(msg: FBFTMessage, keys) -> FBFTMessage:
+    """Set the sender signature: aggregate BLS over keccak of the
+    signable encoding by ALL the node's keys (multibls)."""
+    from ..ref.keccak import keccak256
+
+    msg.sender_sig = keys.sign_hash_aggregated(
+        keccak256(signable_bytes(msg))
+    ).bytes
+    return msg
+
+
+def verify_sender_sig(msg: FBFTMessage) -> bool:
+    """The ingress gate (reference: consensus/checks.go verifySenderKey
+    + message-signature verification): the claimed sender keys must
+    have signed THIS exact message.  Malformed input returns False."""
+    from .. import bls as B
+    from ..ref.keccak import keccak256
+
+    if not msg.sender_pubkeys or len(msg.sender_sig) != SIG_BYTES:
+        return False
+    try:
+        digest = keccak256(signable_bytes(msg))
+    except ValueError:
+        return False
+    return B.verify_aggregate_bytes(
+        msg.sender_pubkeys, digest, msg.sender_sig
+    )
+
+
+def encode_message(msg: FBFTMessage) -> bytes:
+    """Canonical wire form (the payload inside the gossip envelope —
+    the reference uses protobuf harmonymessage.pb.go; this framework
+    uses its fixed little-endian layout)."""
+    out = bytearray(signable_bytes(msg))
+    out += len(msg.sender_sig).to_bytes(4, "little") + msg.sender_sig
     return bytes(out)
 
 
@@ -168,10 +213,12 @@ def decode_message(data: bytes) -> FBFTMessage:
     payload = bytes(view[off:off + plen]); off += plen
     blen = int.from_bytes(view[off:off + 4], "little"); off += 4
     block = bytes(view[off:off + blen]); off += blen
+    slen = int.from_bytes(view[off:off + 4], "little"); off += 4
+    sender_sig = bytes(view[off:off + slen]); off += slen
     if off != len(view):
         raise ValueError("trailing bytes in message")
     return FBFTMessage(
         msg_type=msg_type, view_id=view_id, block_num=block_num,
         block_hash=block_hash, sender_pubkeys=keys, payload=payload,
-        block=block,
+        block=block, sender_sig=sender_sig,
     )

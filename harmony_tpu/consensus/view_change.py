@@ -114,6 +114,37 @@ def construct_viewchange(
     )
 
 
+def verify_prepared_payload(
+    payload: bytes, points: list, committee: list, decider: Decider
+) -> bool:
+    """The embedded PREPARED quorum proof must ITSELF verify (reference:
+    view_change.go onViewChange verifies the aggregated prepared sig +
+    quorum before accepting an M1 claim): aggregate prepare signature
+    over the block hash, checked against its own bitmap, with quorum by
+    that bitmap.  A single malicious validator fabricating a "prepared
+    block" must not be able to poison the collector or re-lock honest
+    validators on a block that never had prepare quorum."""
+    if len(payload) < 32 + 96:
+        return False
+    block_hash = payload[:32]
+    sig_bytes = payload[32:32 + 96]
+    bitmap = payload[32 + 96:]
+    mask = Mask(points)
+    try:
+        mask.set_mask(bitmap)
+        sig = B.Signature.from_bytes(sig_bytes)
+    except (ValueError, KeyError):
+        return False
+    if not decider.is_quorum_achieved_by_mask(
+        bits_from_bytes(bitmap, len(committee))
+    ):
+        return False
+    agg_pk = mask.aggregate_public(device=False)
+    if agg_pk is None:
+        return False
+    return RB.verify(agg_pk, block_hash, sig.point)
+
+
 class ViewChangeCollector:
     """Next-leader side: collect view-change votes until M3 quorum, then
     emit NEWVIEW (reference: view_change.go onViewChange +
@@ -158,6 +189,11 @@ class ViewChangeCollector:
                 return False
             if self.m1_payload and self.m1_payload != msg.m1_payload:
                 return False  # conflicting prepared blocks
+            if not self.m1_payload and not verify_prepared_payload(
+                msg.m1_payload, self.committee_points, self.committee,
+                self.decider,
+            ):
+                return False  # fabricated PREPARED claim
         elif msg.m2_sig:
             if not B.verify_aggregate_bytes(
                 msg.sender_pubkeys, NIL, msg.m2_sig
@@ -251,23 +287,10 @@ def verify_new_view(
     # prepared block — its payload must be present
     if m3_count > m2_count and not msg.m1_payload:
         return False
-    if msg.m1_payload:
-        # the carried PREPARED proof must itself verify: a fabricated
-        # "prepared block" would otherwise re-lock validators on a block
-        # that never had prepare quorum
-        if len(msg.m1_payload) < 32 + 96:
-            return False
-        block_hash = msg.m1_payload[:32]
-        proof = msg.m1_payload[32:]
-        sig_bytes = proof[:96]
-        bitmap = proof[96:]
-        ok1, _ = check_agg(sig_bytes, bitmap, block_hash)
-        if not ok1:
-            return False
-        if not decider.is_quorum_achieved_by_mask(
-            bits_from_bytes(bitmap, len(committee))
-        ):
-            return False
+    if msg.m1_payload and not verify_prepared_payload(
+        msg.m1_payload, points, committee, decider
+    ):
+        return False
     return True
 
 

@@ -21,7 +21,58 @@ from .genesis import Genesis
 from .state import StateDB
 from .state_processor import StateProcessor
 from .types import Block
-from . import rawdb
+from . import rawdb, types
+
+
+def verify_cx_proof(proof, dest_shard: int, engine, config) -> bool:
+    """Authenticate one cross-shard receipt batch (reference:
+    core/block_validator.go:172-236 ValidateCXReceiptsProof):
+
+    (1) the receipts hash to the destination's group root;
+    (2) the (shard, group-root) pairs hash to the source header's
+        out_cx_root;
+    (3) every receipt routes to this shard and claims the source
+        header's shard/number;
+    (4) the source header's seal verifies against the SOURCE shard's
+        committee (engine.verify_header_signature) — skipped only when
+        no engine is wired (test chains without consensus).
+
+    Fabricated receipts fail (1)/(2); receipts lifted from another
+    shard's group fail (3); a forged source header fails (4).
+    """
+    try:
+        header = rawdb.decode_header(proof.header_bytes)
+    except (ValueError, IndexError):
+        return False
+    if not proof.receipts:
+        return False
+    for cx in proof.receipts:
+        if cx.to_shard != dest_shard:
+            return False
+        if cx.from_shard != header.shard_id or cx.block_num != header.block_num:
+            return False
+    if dest_shard not in proof.shard_ids:
+        return False
+    if len(proof.shard_ids) != len(proof.shard_hashes):
+        return False
+    group = proof.shard_hashes[proof.shard_ids.index(dest_shard)]
+    if types.cx_group_root(proof.receipts) != group:
+        return False
+    out = bytearray()
+    for sid, h in zip(proof.shard_ids, proof.shard_hashes):
+        out += sid.to_bytes(4, "little") + h
+    from ..ref.keccak import keccak256
+
+    if keccak256(bytes(out)) != header.out_cx_root:
+        return False
+    if engine is not None:
+        if len(proof.commit_sig) != 96:
+            return False
+        return engine.verify_header_signature(
+            header, proof.commit_sig, proof.commit_bitmap,
+            config.is_staking(header.epoch),
+        )
+    return True
 
 
 class ChainError(ValueError):
@@ -186,6 +237,9 @@ class Blockchain:
         state = self._state.copy()
         epoch = block.header.epoch
         result = self.processor.process(state, block, epoch)
+        groups = types.group_cx_by_shard(result.outgoing_cx)
+        if types.out_cx_root(groups) != block.header.out_cx_root:
+            raise ChainError("outgoing receipt root mismatch")
         elected = self.post_process(
             state, block.block_num, epoch,
             block.header.last_commit_bitmap or None,
@@ -193,6 +247,29 @@ class Blockchain:
         if state.root() != block.header.root:
             raise ChainError("state root mismatch after execution")
         return state, result, elected
+
+    def verify_incoming_receipts(self, block: Block) -> list:
+        """Reject unauthenticated / double-spent CX batches (reference:
+        core/blockchain_impl.go:441-478 VerifyIncomingReceipts).  Raises
+        ChainError; returns the (from_shard, block_num) keys so insert
+        can mark them spent without re-decoding."""
+        seen: list = []
+        for proof in block.incoming_receipts:
+            try:
+                src = rawdb.decode_header(proof.header_bytes)
+            except (ValueError, IndexError) as e:
+                raise ChainError(f"bad cx proof header: {e}") from e
+            key = (src.shard_id, src.block_num)
+            if key in seen or rawdb.is_cx_spent(self.db, *key):
+                raise ChainError("cx receipt batch double spend")
+            seen.append(key)
+            if not verify_cx_proof(proof, self.shard_id, self.engine,
+                                   self.config):
+                raise ChainError(
+                    f"invalid cx proof from shard {src.shard_id} "
+                    f"block {src.block_num}"
+                )
+        return seen
 
     def insert_chain(self, blocks: list, commit_sigs: list | None = None,
                      verify_seals: bool = True) -> int:
@@ -243,7 +320,10 @@ class Blockchain:
         # execution + persistence pass
         inserted = 0
         for block, proof in zip(blocks, proofs):
+            spent_keys = self.verify_incoming_receipts(block)
             state, result, elected = self._execute(block)
+            for from_shard, num in spent_keys:
+                rawdb.write_cx_spent(self.db, from_shard, num)
             if elected is not None:
                 rawdb.write_shard_state(self.db, elected.epoch, elected)
                 self._committee_cache.pop(elected.epoch, None)

@@ -30,6 +30,7 @@ _COMMIT_SIG = b"s"      # s || num(8) -> [96B sig || bitmap]
 _HEAD = b"LastBlock"    # -> num(8)
 _STATE = b"S"           # S || root -> serialized StateDB
 _CX = b"x"              # x || to_shard(4) || num(8) -> outgoing cx blob
+_CX_SPENT = b"X"        # X || from_shard(4) || num(8) -> spent marker
 
 
 # -- codecs -----------------------------------------------------------------
@@ -53,12 +54,14 @@ def decode_header(blob: bytes) -> Header:
     parent_hash = fields.raw(32)
     root = fields.raw(32)
     tx_root = fields.raw(32)
+    out_cx = fields.raw(32)
     extra = fields.bytes_()
     return Header(
         shard_id=shard_id, block_num=block_num, epoch=epoch,
         view_id=view_id, parent_hash=parent_hash, root=root,
-        tx_root=tx_root, timestamp=timestamp, last_commit_sig=r.bytes_(),
-        last_commit_bitmap=r.bytes_(), extra=extra,
+        tx_root=tx_root, out_cx_root=out_cx, timestamp=timestamp,
+        last_commit_sig=r.bytes_(), last_commit_bitmap=r.bytes_(),
+        extra=extra,
     )
 
 
@@ -98,6 +101,7 @@ def decode_staking_tx(blob: bytes) -> StakingTransaction:
     nonce = f.int_()
     gas_price = f.big_()
     gas_limit = f.int_()
+    shard_id = f.int_(4)
     directive = Directive(f.int_(1))
     fields = {}
     while f.off < len(f.view):
@@ -111,12 +115,36 @@ def decode_staking_tx(blob: bytes) -> StakingTransaction:
             fields[key] = f.bytes_().decode()
     return StakingTransaction(
         nonce=nonce, gas_price=gas_price, gas_limit=gas_limit,
-        directive=directive, fields=fields, sig=r.bytes_(),
+        directive=directive, fields=fields, shard_id=shard_id,
+        sig=r.bytes_(),
     )
 
 
 def encode_cx(cx: CXReceipt) -> bytes:
     return cx.encode()
+
+
+def encode_cx_proof(p) -> bytes:
+    return p.encode()
+
+
+def decode_cx_proof(blob: bytes):
+    from .types import CXReceiptsProof
+
+    r = _Reader(blob)
+    receipts = [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    header_bytes = r.bytes_()
+    commit_sig = r.bytes_()
+    commit_bitmap = r.bytes_()
+    shard_ids, shard_hashes = [], []
+    for _ in range(r.int_(4)):
+        shard_ids.append(r.int_(4))
+        shard_hashes.append(r.bytes_())
+    return CXReceiptsProof(
+        receipts=receipts, header_bytes=header_bytes,
+        commit_sig=commit_sig, commit_bitmap=commit_bitmap,
+        shard_ids=shard_ids, shard_hashes=shard_hashes,
+    )
 
 
 def decode_cx(blob: bytes) -> CXReceipt:
@@ -137,8 +165,8 @@ def encode_body(block: Block, chain_id: int) -> bytes:
     for stx in block.staking_transactions:
         out += _enc_bytes(encode_staking_tx(stx, chain_id))
     out += _enc_int(len(block.incoming_receipts), 4)
-    for cx in block.incoming_receipts:
-        out += _enc_bytes(encode_cx(cx))
+    for p in block.incoming_receipts:
+        out += _enc_bytes(encode_cx_proof(p))
     out += _enc_int(len(block.execution_order), 4)
     out += bytes(block.execution_order)
     return bytes(out)
@@ -148,9 +176,9 @@ def decode_body(blob: bytes):
     r = _Reader(blob)
     txs = [decode_tx(r.bytes_()) for _ in range(r.int_(4))]
     stxs = [decode_staking_tx(r.bytes_()) for _ in range(r.int_(4))]
-    cxs = [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    cxps = [decode_cx_proof(r.bytes_()) for _ in range(r.int_(4))]
     order = list(r.raw(r.int_(4)))
-    return txs, stxs, cxs, order
+    return txs, stxs, cxps, order
 
 
 # -- schema accessors -------------------------------------------------------
@@ -236,6 +264,21 @@ def read_outgoing_cx(db, to_shard: int, num: int) -> list:
         return []
     r = _Reader(blob)
     return [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+
+
+def write_cx_spent(db, from_shard: int, num: int):
+    """Mark a source block's receipt batch consumed on this shard
+    (reference: WriteCXReceiptsProofSpent — replaying the same proof in
+    a later block must fail as a double spend)."""
+    db.put(_CX_SPENT + from_shard.to_bytes(4, "little")
+           + num.to_bytes(8, "little"), b"\x01")
+
+
+def is_cx_spent(db, from_shard: int, num: int) -> bool:
+    return db.get(
+        _CX_SPENT + from_shard.to_bytes(4, "little")
+        + num.to_bytes(8, "little")
+    ) is not None
 
 
 def encode_block(block: Block, chain_id: int) -> bytes:

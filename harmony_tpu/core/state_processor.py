@@ -125,6 +125,11 @@ class StateProcessor:
             sender = tx.sender(self.chain_id)
         except ValueError as e:
             raise ExecutionError(f"bad signature: {e}") from e
+        if tx.shard_id != self.shard_id:
+            # the shard is inside signing_bytes, so this binds the
+            # SIGNATURE to one shard — a delegate/undelegate signed for
+            # shard 0 must not replay on shard 1 at the same nonce
+            raise ExecutionError("staking tx bound to a different shard")
         if tx.nonce != state.nonce(sender):
             raise ExecutionError(
                 f"bad nonce: want {state.nonce(sender)} got {tx.nonce}"
@@ -306,6 +311,7 @@ class StateProcessor:
                 if cx is not None:
                     res.outgoing_cx.append(cx)
             res.gas_used += receipt.gas_used
-        for cx in block.incoming_receipts:
-            self.apply_incoming_receipt(state, cx)
+        for proof in block.incoming_receipts:
+            for cx in proof.receipts:
+                self.apply_incoming_receipt(state, cx)
         return res

@@ -134,6 +134,11 @@ class StakingTransaction:
     gas_limit: int
     directive: Directive
     fields: dict  # directive-specific; bytes/int/str values
+    # the shard this directive executes on, BOUND INTO THE SIGNATURE:
+    # without it one signed staking tx would replay on every shard at
+    # the same nonce (the reference reaches the same safety by routing
+    # all staking txs to shard 0 — staking/types/transaction.go)
+    shard_id: int = 0
     sig: bytes = b""
 
     def _enc_fields(self) -> bytes:
@@ -157,6 +162,7 @@ class StakingTransaction:
             + _enc_int(self.nonce)
             + _enc_big(self.gas_price)
             + _enc_int(self.gas_limit)
+            + _enc_int(self.shard_id, 4)
             + _enc_int(int(self.directive), 1)
             + self._enc_fields()
         )
@@ -214,6 +220,72 @@ class CXReceipt:
         return keccak256(self.encode())
 
 
+def cx_group_root(cxs: list) -> bytes:
+    """Commitment over one destination shard's receipt group: keccak of
+    the concatenated receipt hashes (the framework's items_root shape;
+    the reference uses DeriveSha — core/types/cx_receipt.go)."""
+    out = bytearray()
+    for cx in cxs:
+        out += cx.hash()
+    return keccak256(bytes(out)) if out else bytes(32)
+
+
+def group_cx_by_shard(cxs: list) -> dict:
+    """Group outgoing receipts by destination shard — THE grouping that
+    feeds the consensus-critical out_cx_root commitment (proposer,
+    replay, and export must all use this one)."""
+    by_shard: dict = {}
+    for cx in cxs:
+        by_shard.setdefault(cx.to_shard, []).append(cx)
+    return by_shard
+
+
+def out_cx_root(groups: dict) -> bytes:
+    """The header's outgoing-receipt commitment: keccak over sorted
+    (LE4(to_shard) || group_root) pairs of the NON-EMPTY groups
+    (reference: block/header OutgoingReceiptHash built in
+    core/blockchain_impl.go CXMerkleProof; empty -> zero hash)."""
+    out = bytearray()
+    for sid in sorted(groups):
+        if not groups[sid]:
+            continue
+        out += sid.to_bytes(4, "little")
+        out += cx_group_root(groups[sid])
+    return keccak256(bytes(out)) if out else bytes(32)
+
+
+@dataclass
+class CXReceiptsProof:
+    """A destination shard's authenticated receipt batch (reference:
+    core/types/cx_receipt.go CXReceiptsProof + CXMerkleProof): the
+    receipts, the source-shard header they executed in, that header's
+    commit signature + bitmap (its seal), and the sibling group roots
+    proving the receipts against the header's out_cx_root."""
+
+    receipts: list  # CXReceipts, all with one to_shard
+    header_bytes: bytes  # encoded source header (rawdb.encode_header)
+    commit_sig: bytes  # 96-byte aggregate seal over the source header
+    commit_bitmap: bytes
+    shard_ids: list = field(default_factory=list)  # sorted dest shards
+    shard_hashes: list = field(default_factory=list)  # group roots
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += _enc_int(len(self.receipts), 4)
+        for cx in self.receipts:
+            out += _enc_bytes(cx.encode())
+        out += _enc_bytes(self.header_bytes)
+        out += _enc_bytes(self.commit_sig)
+        out += _enc_bytes(self.commit_bitmap)
+        out += _enc_int(len(self.shard_ids), 4)
+        for sid, h in zip(self.shard_ids, self.shard_hashes):
+            out += _enc_int(sid, 4) + _enc_bytes(h)
+        return bytes(out)
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+
 @dataclass
 class Block:
     """Header + body.  The header's ``root`` is the post-state root and
@@ -230,7 +302,7 @@ class Block:
     header: object  # chain.header.Header
     transactions: list = field(default_factory=list)
     staking_transactions: list = field(default_factory=list)
-    incoming_receipts: list = field(default_factory=list)  # CXReceipts
+    incoming_receipts: list = field(default_factory=list)  # CXReceiptsProofs
     execution_order: list = field(default_factory=list)  # 0/1 flags
 
     def hash(self) -> bytes:
@@ -263,5 +335,5 @@ class Block:
     def tx_root(self, chain_id: int = 0) -> bytes:
         return self.items_root(
             [t.hash(chain_id) for t, _ in self.ordered_txs()]
-            + [cx.hash() for cx in self.incoming_receipts]
+            + [p.hash() for p in self.incoming_receipts]
         )

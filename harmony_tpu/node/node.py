@@ -29,6 +29,8 @@ from ..consensus.messages import (
     MsgType,
     decode_message,
     encode_message,
+    sign_message,
+    verify_sender_sig,
 )
 from ..consensus.quorum import Decider, Policy
 from ..consensus.sender import MessageSender
@@ -142,6 +144,11 @@ class Node:
         self._prepared_block_bytes = b""
         self._reproposal = None  # block carried through a view change
         self._expected_reproposal_hash = None
+        # one announce-vote per (block_num, view_id): a validator must
+        # never prepare two different blocks in the same round — the
+        # second valid-looking announce (equivocating leader or forged
+        # sender) is ignored, closing the two-block commit-quorum fork
+        self._announce_voted: tuple | None = None
 
     # -- gossip ingress -----------------------------------------------------
 
@@ -229,6 +236,13 @@ class Node:
             return
         if msg.block_num != self.block_num:
             return  # stale/future round (sync handles catch-up)
+        # the sender must have SIGNED this exact message — without this
+        # gate any peer could replay/forge another member's ANNOUNCE /
+        # PREPARED / COMMITTED (reference verifies the message signature
+        # on every consensus message, consensus/checks.go)
+        if not verify_sender_sig(msg):
+            self.dropped_messages += 1
+            return
         handler = {
             MsgType.ANNOUNCE: self._on_announce,
             MsgType.PREPARE: self._on_prepare,
@@ -290,8 +304,19 @@ class Node:
             elif carried:
                 return None  # unverifiable proof: reject
         try:
+            # CX batches must be verified BEFORE voting: a quorum that
+            # signs a block with a fabricated/replayed proof would stall
+            # the round (everyone's insert rejects it) and the bad
+            # PREPARED proof could ride view changes as M1
+            self.chain.verify_incoming_receipts(block)
             state = self.chain.state().copy()
-            self.chain.processor.process(state, block, header.epoch)
+            result = self.chain.processor.process(state, block, header.epoch)
+            from ..core.types import group_cx_by_shard, out_cx_root
+
+            if out_cx_root(
+                group_cx_by_shard(result.outgoing_cx)
+            ) != header.out_cx_root:
+                return None
             self.chain.post_process(
                 state, header.block_num, header.epoch,
                 header.last_commit_bitmap or None,
@@ -314,10 +339,13 @@ class Node:
             msg.sender_pubkeys[0] != self._round_leader_key
         ):
             return
+        if self._announce_voted == (msg.block_num, self.view_id):
+            return  # already prepared a block this round
         block = self._validate_proposed_block(msg.block)
         if block is None:
             return
         self._pending_block = block
+        self._announce_voted = (msg.block_num, self.view_id)
         # commit payloads bind the block header's own view (differs from
         # the round view only for a view-change re-proposal)
         self.validator.cfg.payload_view_id = block.header.view_id
@@ -480,7 +508,7 @@ class Node:
             self.keys, new_view, self.block_num,
             prepared_hash, self._prepared_proof,
         )
-        msg = FBFTMessage(
+        msg = sign_message(FBFTMessage(
             msg_type=MsgType.VIEWCHANGE,
             view_id=new_view,
             block_num=self.block_num,
@@ -488,7 +516,7 @@ class Node:
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=encode_viewchange(vc),
             block=self._prepared_block_bytes if prepared_hash else b"",
-        )
+        ), self.keys)
         self._round_start = time.monotonic()
         # the view's designated leader collects VC votes — start my
         # collector (and self-vote) if that's me
@@ -539,7 +567,7 @@ class Node:
         block_bytes = (
             getattr(self, "_vc_block_bytes", b"") if nv.m1_payload else b""
         )
-        out = FBFTMessage(
+        out = sign_message(FBFTMessage(
             msg_type=MsgType.NEWVIEW,
             view_id=new_view,
             block_num=self.block_num,
@@ -548,7 +576,7 @@ class Node:
             sender_pubkeys=[k.pub.bytes for k in self.keys],
             payload=encode_newview(nv),
             block=block_bytes,
-        )
+        ), self.keys)
         self._broadcast(out, retry=True)
         self._adopt_new_view(new_view, nv, block_bytes)
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from ..chain.header import Header
 from ..core.state_processor import ExecutionError
-from ..core.types import Block
+from ..core.types import Block, group_cx_by_shard, out_cx_root
 
 DEFAULT_BLOCK_TX_CAP = 1024
 
@@ -44,6 +44,7 @@ class Worker:
         epoch = self.chain.epoch_of(num)
 
         plain, staking, order = [], [], []
+        outgoing = []
         state = self.chain.state().copy()
         gas_used = 0
         if self.tx_pool is not None:
@@ -61,12 +62,17 @@ class Worker:
                             state, tx, num, gas_used
                         )
                         plain.append(tx)
+                        if cx is not None:
+                            outgoing.append(cx)
                     order.append(1 if is_staking else 0)
                     gas_used += receipt.gas_used
                 except ExecutionError:
                     continue
-        for cx in incoming_receipts or []:
-            self.chain.processor.apply_incoming_receipt(state, cx)
+        # incoming_receipts are CXReceiptsProof batches (authenticated
+        # at pool ingestion AND re-verified by every validator/replayer)
+        for proof in incoming_receipts or []:
+            for cx in proof.receipts:
+                self.chain.processor.apply_incoming_receipt(state, cx)
         # the parent's quorum proof rides in this header (reference:
         # block/header LastCommitSignature/Bitmap) and drives reward +
         # availability finalization
@@ -89,6 +95,7 @@ class Worker:
             parent_hash=parent.hash(),
             root=state.root(),
             tx_root=block.tx_root(self.chain.config.chain_id),
+            out_cx_root=out_cx_root(group_cx_by_shard(outgoing)),
             timestamp=timestamp,
             last_commit_sig=last_sig,
             last_commit_bitmap=last_bitmap,

@@ -1,18 +1,22 @@
-"""Cross-shard transfers end to end: debit on the source shard, receipt
-export, destination inclusion, credit (the reference's CXReceipt flow
-— SURVEY.md §2.7 cross-shard traffic)."""
+"""Cross-shard transfers end to end: debit on the source shard,
+authenticated proof export, destination verification + inclusion,
+credit (the reference's CXReceiptsProof flow — SURVEY.md §2.7;
+core/block_validator.go ValidateCXReceiptsProof)."""
 
-from harmony_tpu.core.blockchain import Blockchain
+import pytest
+
+from harmony_tpu.core.blockchain import Blockchain, ChainError, verify_cx_proof
 from harmony_tpu.core.genesis import Genesis, dev_genesis
 from harmony_tpu.core.kv import MemKV
 from harmony_tpu.core.tx_pool import TxPool
-from harmony_tpu.core.types import Transaction
+from harmony_tpu.core.types import CXReceipt, Transaction
 from harmony_tpu.node.cross_shard import (
     CXPool,
     cx_topic,
     decode_cx_batch,
     encode_cx_batch,
     export_receipts,
+    make_cx_proof,
 )
 from harmony_tpu.node.worker import Worker
 
@@ -30,33 +34,40 @@ def _two_shards():
     return c0, c1, ecdsa_keys
 
 
+def _send_cross_shard(c0, sender, to, value):
+    pool0 = TxPool(CHAIN_ID, 0, c0.state)
+    tx = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=1,
+        to=to, value=value,
+    ).sign(sender, CHAIN_ID)
+    pool0.add(tx)
+    block0 = Worker(c0, pool0).propose_block(view_id=1)
+    assert c0.insert_chain([block0], verify_seals=False) == 1
+    return block0
+
+
 def test_cross_shard_transfer_end_to_end():
     c0, c1, keys = _two_shards()
     sender = keys[0]
     to = b"\x0c" * 20
-    pool0 = TxPool(CHAIN_ID, 0, c0.state)
-    tx = Transaction(
-        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=1,
-        to=to, value=9999,
-    ).sign(sender, CHAIN_ID)
-    pool0.add(tx)
-
-    # source shard commits the debit and exports the receipt
-    block0 = Worker(c0, pool0).propose_block(view_id=1)
-    assert c0.insert_chain([block0], verify_seals=False) == 1
-    sender_bal = c0.state().balance(sender.address())
+    _send_cross_shard(c0, sender, to, 9999)
     assert c0.state().balance(to) == 0  # no local credit
-    groups = export_receipts(c0, 1, shard_count=2)
-    assert list(groups) == [1] and groups[1][0].amount == 9999
+
+    proofs = export_receipts(c0, 1, shard_count=2)
+    assert list(proofs) == [1]
+    assert proofs[1].receipts[0].amount == 9999
+    # proof self-consistency (merkle chain up to the source header)
+    assert verify_cx_proof(proofs[1], 1, None, c1.config)
 
     # transport: encode -> (gossip topic) -> decode at destination
-    blob = encode_cx_batch(0, 1, groups[1])
+    blob = encode_cx_batch(proofs[1])
+    assert decode_cx_batch(blob).receipts[0].amount == 9999
     assert cx_topic("localnet", 1).endswith("/1/cx")
-    cx_pool = CXPool(shard_id=1)
+    cx_pool = CXPool(shard_id=1, config=c1.config)
     assert cx_pool.add_batch(blob) == 1
     assert cx_pool.add_batch(blob) == 0  # duplicate batch dropped
 
-    # destination proposer includes the receipts; credit lands
+    # destination proposer includes the proof; credit lands
     incoming = cx_pool.drain()
     block1 = Worker(c1, None).propose_block(
         view_id=1, incoming_receipts=incoming
@@ -66,12 +77,15 @@ def test_cross_shard_transfer_end_to_end():
     assert c1.state().balance(to) == 9999
     assert len(cx_pool) == 0
 
-    # replay integrity: tampering with an included receipt breaks the
-    # body commitment (tx_root covers incoming receipts)
-    import pytest
+    # double spend: the same source batch cannot enter a later block
+    block2 = Worker(c1, None).propose_block(
+        view_id=2, incoming_receipts=incoming
+    )
+    with pytest.raises(ChainError):
+        c1.insert_chain([block2], verify_seals=False)
 
-    from harmony_tpu.core.blockchain import ChainError
-
+    # replay integrity: tampering with an included receipt breaks both
+    # the merkle chain and the body commitment
     c1b = Blockchain(MemKV(), Genesis(
         config=c1.config, shard_id=1,
         alloc=dict(c1.genesis.alloc), committee=list(c1.genesis.committee),
@@ -79,30 +93,78 @@ def test_cross_shard_transfer_end_to_end():
     tampered = Worker(c1b, None).propose_block(
         view_id=1, incoming_receipts=incoming
     )
-    tampered.incoming_receipts[0].amount = 10**18
+    tampered.incoming_receipts[0].receipts[0].amount = 10**18
     with pytest.raises(ChainError):
         c1b.insert_chain([tampered], verify_seals=False)
 
 
+def test_fabricated_receipts_rejected():
+    """ADVICE r1 (high): unauthenticated CX batches must not mint
+    balance — a fabricated batch fails the merkle/header chain."""
+    c0, c1, keys = _two_shards()
+    _send_cross_shard(c0, keys[0], b"\x0c" * 20, 50)
+    proof = make_cx_proof(c0, 1, 1, shard_count=2)
+
+    # fabricate: bump the amount (group root no longer matches)
+    evil = decode_cx_batch(proof.encode())
+    evil.receipts[0].amount = 10**18
+    cx_pool = CXPool(shard_id=1, config=c1.config)
+    assert cx_pool.add_batch(evil.encode()) == 0
+
+    # fabricate: rebuild roots over the forged receipts — now the
+    # header's out_cx_root no longer matches
+    from harmony_tpu.core.types import cx_group_root
+
+    evil.shard_hashes = [cx_group_root(evil.receipts)]
+    evil.shard_ids = [1]
+    assert cx_pool.add_batch(evil.encode()) == 0
+
+    # fabricate: forge the header too — the engine-wired pool rejects
+    # it for having no valid committee seal
+    from harmony_tpu.chain.engine import Engine, EpochContext
+
+    def provider(shard_id, epoch):
+        return EpochContext(c0.committee_for_epoch(epoch))
+
+    engine = Engine(provider, device=False)
+    from harmony_tpu.core import rawdb
+
+    hdr = rawdb.decode_header(evil.header_bytes)
+    hdr.out_cx_root = __import__(
+        "harmony_tpu.ref.keccak", fromlist=["keccak256"]
+    ).keccak256(
+        (1).to_bytes(4, "little") + cx_group_root(evil.receipts)
+    )
+    evil.header_bytes = rawdb.encode_header(hdr)
+    sealed_pool = CXPool(shard_id=1, engine=engine, config=c1.config)
+    assert sealed_pool.add_batch(evil.encode()) == 0
+
+    # the honest proof (no seal stored on an engine-less source chain)
+    # is also rejected by a seal-enforcing pool — receipts from an
+    # unsealed block are not final
+    assert sealed_pool.add_batch(proof.encode()) == 0
+
+
 def test_cx_pool_caps_and_filtering():
-    cx_pool = CXPool(shard_id=1, cap=2)
-    from harmony_tpu.core.types import CXReceipt
+    c0, c1, keys = _two_shards()
+    cx_pool = CXPool(shard_id=1, cap=2, config=c1.config)
 
-    def batch(from_shard, num, n, to_shard=1):
-        cxs = [
-            CXReceipt(
-                tx_hash=bytes([i]) * 32, sender=b"\x01" * 20,
-                to=b"\x02" * 20, amount=i + 1, from_shard=from_shard,
-                to_shard=to_shard, block_num=num,
-            )
-            for i in range(n)
-        ]
-        return encode_cx_batch(from_shard, num, cxs)
+    # wrong destination: a batch claiming shard 3 receipts never enters
+    # a shard-1 pool
+    _send_cross_shard(c0, keys[0], b"\x0d" * 20, 7)
+    proof = make_cx_proof(c0, 1, 1, shard_count=2)
+    wrong = decode_cx_batch(proof.encode())
+    for cx in wrong.receipts:
+        cx.to_shard = 3
+    assert cx_pool.add_batch(wrong.encode()) == 0
 
-    # wrong destination filtered out entirely
-    assert cx_pool.add_batch(batch(0, 1, 1, to_shard=3)) == 0
-    assert cx_pool.add_batch(batch(0, 2, 2)) == 2
-    # cap reached
-    assert cx_pool.add_batch(batch(2, 3, 1)) == 0
-    assert len(cx_pool.drain()) == 2
-    assert cx_pool.add_batch(batch(2, 3, 1)) == 1
+    assert cx_pool.add_batch(proof.encode()) == 1
+    assert len(cx_pool.drain()) == 1
+
+    # spent tracking: a pool wired to the chain's spent set refuses a
+    # batch the chain already consumed
+    tracked = CXPool(
+        shard_id=1, config=c1.config,
+        spent=lambda fs, num: (fs, num) == (0, 1),
+    )
+    assert tracked.add_batch(proof.encode()) == 0
