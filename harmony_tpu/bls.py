@@ -116,6 +116,18 @@ def aggregate_sigs(sigs) -> Signature:
     return Signature(RB.aggregate_sigs([s.point for s in sigs]))
 
 
+def verify_point(pk_point, payload: bytes, sig_point) -> bool:
+    """One aggregate-signature check, routed to the TPU ops when the
+    device path is live (device.device_enabled()) and to the host
+    bigint twin otherwise — THE verification choke point every
+    consensus check funnels through."""
+    from . import device as DV
+
+    if DV.device_enabled():
+        return DV.verify_on_device(pk_point, payload, sig_point)
+    return RB.verify(pk_point, payload, sig_point)
+
+
 def verify_aggregate_bytes(
     pubkeys_bytes, payload: bytes, sig_bytes: bytes
 ) -> bool:
@@ -133,7 +145,7 @@ def verify_aggregate_bytes(
         sig = Signature.from_bytes(sig_bytes)
     except (ValueError, KeyError):
         return False
-    return RB.verify(agg_pk.point, payload, sig.point)
+    return verify_point(agg_pk.point, payload, sig.point)
 
 
 @functools.lru_cache(maxsize=1024)

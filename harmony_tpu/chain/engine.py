@@ -67,33 +67,47 @@ class _LRU(OrderedDict):
 # Device batches are padded up to one of these pinned sizes (chunked
 # above the largest) so EVERY verify reuses a precompiled program — no
 # shape-polymorphic recompiles on the hot path (SURVEY.md §7.3:
-# "pinned batch shapes with bucketing").  Capped at 64: XLA:CPU's LLVM
-# JIT hits allocation failures compiling the 256-wide programs on the
-# test image (TPU compiles are fine; revisit the cap on real hardware).
-VERIFY_BUCKETS = (8, 64)
+# "pinned batch shapes with bucketing").  CPU caps at 64: XLA:CPU's
+# LLVM JIT hits allocation failures compiling the 256-wide programs on
+# the test image; real TPUs take the wide buckets for replay throughput.
+VERIFY_BUCKETS_CPU = (8, 64)
+VERIFY_BUCKETS_TPU = (8, 64, 256)
+
+
+def verify_buckets() -> tuple:
+    from .. import device as DV
+
+    return VERIFY_BUCKETS_TPU if DV.device_enabled() else VERIFY_BUCKETS_CPU
+
+
+# back-compat name (tests reference it)
+VERIFY_BUCKETS = VERIFY_BUCKETS_CPU
 
 
 def bucket_size(n: int) -> int:
-    for b in VERIFY_BUCKETS:
+    buckets = verify_buckets()
+    for b in buckets:
         if n <= b:
             return b
-    return VERIFY_BUCKETS[-1]
+    return buckets[-1]
 
 
 class Engine:
     """Header signature verification with epoch-ctx + verified-sig caches."""
 
     def __init__(self, committee_provider, sig_cache_size: int = 4096,
-                 device: bool = True):
+                 device: bool | None = None):
         """committee_provider(shard_id, epoch) -> EpochContext.
 
-        ``device=False`` routes batch verification through the host
-        bigint path instead of the TPU ops: for CPU-only test
-        environments where XLA's persistent-cache/compile machinery is
-        unreliable (this image aborts deserializing the big pairing
-        executables — see tests/conftest.py).  Device-path correctness
-        is covered by the ops parity suite; deployment default stays
-        device=True."""
+        ``device=None`` (default) resolves automatically: the TPU ops
+        when JAX's default backend is an accelerator, the host bigint
+        twin on the CPU-only test image (where XLA's persistent-cache/
+        compile machinery is unreliable — see tests/conftest.py).
+        Device-path correctness is covered by the ops parity suite."""
+        if device is None:
+            from .. import device as DV
+
+            device = DV.device_enabled()
         self._provider = committee_provider
         self._epoch_ctx: dict = {}
         self._verified = _LRU(sig_cache_size)
@@ -137,11 +151,17 @@ class Engine:
             return False
         if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
             return False
-        agg_pk = mask.aggregate_public(device=False)
+        agg_pk = mask.aggregate_public(device=self.device)
         if agg_pk is None:
             return False
         payload = self._commit_payload(header, is_staking)
-        if not RB.verify(agg_pk, payload, sig):
+        if self.device:
+            from .. import device as DV
+
+            ok = DV.verify_on_device(agg_pk, payload, sig)
+        else:
+            ok = RB.verify(agg_pk, payload, sig)
+        if not ok:
             return False
         self._verified.put(cache_key)
         return True
@@ -211,8 +231,9 @@ class Engine:
                     header, sig_bytes, bitmap = items[idx]
                     self._verified.put((header.hash(), sig_bytes, bitmap))
             return results
-        for chunk_start in range(0, len(survivors), VERIFY_BUCKETS[-1]):
-            chunk = survivors[chunk_start:chunk_start + VERIFY_BUCKETS[-1]]
+        widest = verify_buckets()[-1]
+        for chunk_start in range(0, len(survivors), widest):
+            chunk = survivors[chunk_start:chunk_start + widest]
             n, padded = len(chunk), bucket_size(len(chunk))
             # pad with copies of the first element: results are sliced
             # back to n, so pad lanes are never consulted
@@ -220,9 +241,12 @@ class Engine:
             pk = np.asarray(I.g1_batch_affine([chunk[i][1] for i in sel]))
             hh = np.asarray(I.g2_batch_affine([chunk[i][2] for i in sel]))
             sg = np.asarray(I.g2_batch_affine([chunk[i][3] for i in sel]))
+            from .. import device as DV
+
             ok = np.asarray(
                 OB.verify(jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg))
             )[:n]
+            DV.COUNTERS["batch_verify"] += 1
             for (idx, _, _, _), good in zip(chunk, ok):
                 if bool(good):
                     results[idx] = True

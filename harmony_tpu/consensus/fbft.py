@@ -239,6 +239,8 @@ class Validator(_Node):
         aggregate signature — the reference's validator-side check
         (validator.go:217-236; engine.go:619-642 uses the same shape).
         Malformed payloads return False, never raise."""
+        from .. import device as DV
+
         try:
             mask = Mask(self.committee_points)
             sig_bytes, bitmap = decode_sig_and_bitmap(
@@ -247,13 +249,13 @@ class Validator(_Node):
             mask.set_mask(bitmap)
             if not self.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
                 return False
-            agg_pk = mask.aggregate_public(device=False)
+            agg_pk = mask.aggregate_public(device=DV.device_enabled())
             if agg_pk is None:
                 return False
             sig = B.Signature.from_bytes(sig_bytes)
         except ValueError:
             return False
-        return RB.verify(agg_pk, payload, sig.point)
+        return B.verify_point(agg_pk, payload, sig.point)
 
     def on_prepared(self, msg: FBFTMessage):
         """Verify the prepare proof; if valid, send the commit vote

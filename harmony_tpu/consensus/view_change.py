@@ -129,6 +129,8 @@ def verify_prepared_payload(
     block_hash = payload[:32]
     sig_bytes = payload[32:32 + 96]
     bitmap = payload[32 + 96:]
+    from .. import device as DV
+
     mask = Mask(points)
     try:
         mask.set_mask(bitmap)
@@ -139,10 +141,10 @@ def verify_prepared_payload(
         bits_from_bytes(bitmap, len(committee))
     ):
         return False
-    agg_pk = mask.aggregate_public(device=False)
+    agg_pk = mask.aggregate_public(device=DV.device_enabled())
     if agg_pk is None:
         return False
-    return RB.verify(agg_pk, block_hash, sig.point)
+    return B.verify_point(agg_pk, block_hash, sig.point)
 
 
 class ViewChangeCollector:
@@ -253,6 +255,8 @@ def verify_new_view(
     checked against its own bitmap and quorum)."""
     points = [B.PublicKey.from_bytes(k).point for k in committee]
 
+    from .. import device as DV
+
     def check_agg(sig_bytes, bitmap, payload) -> tuple:
         mask = Mask(points)
         try:
@@ -260,11 +264,11 @@ def verify_new_view(
             sig = B.Signature.from_bytes(sig_bytes)
         except (ValueError, KeyError):
             return False, 0
-        agg_pk = mask.aggregate_public(device=False)
+        agg_pk = mask.aggregate_public(device=DV.device_enabled())
         if agg_pk is None:
             return False, 0
         return (
-            RB.verify(agg_pk, payload, sig.point),
+            B.verify_point(agg_pk, payload, sig.point),
             mask.count_enabled(),
         )
 

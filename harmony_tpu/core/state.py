@@ -90,11 +90,23 @@ class Account:
     balance: int = 0
     nonce: int = 0
     validator: ValidatorWrapper | None = None
+    code: bytes = b""  # EVM bytecode (contract accounts)
+    storage: dict = field(default_factory=dict)  # 32B slot -> int
 
     def encode(self) -> bytes:
         out = _enc_big(self.balance) + _enc_int(self.nonce)
         if self.validator is not None:
             out += b"\x01" + self.validator.encode()
+        else:
+            out += b"\x00"
+        if self.code or self.storage:
+            out += b"\x01" + _enc_bytes(self.code)
+            live = sorted(
+                (k, v) for k, v in self.storage.items() if v
+            )
+            out += _enc_int(len(live), 4)
+            for k, v in live:
+                out += _enc_bytes(k) + _enc_big(v)
         else:
             out += b"\x00"
         return out
@@ -139,6 +151,26 @@ class StateDB:
         a = self._accounts.get(addr)
         return a.validator if a else None
 
+    # -- EVM surface (code + storage) --------------------------------------
+
+    def code(self, addr: bytes) -> bytes:
+        a = self._accounts.get(addr)
+        return a.code if a else b""
+
+    def set_code(self, addr: bytes, code: bytes):
+        self.account(addr).code = code
+
+    def storage_get(self, addr: bytes, slot: bytes) -> int:
+        a = self._accounts.get(addr)
+        return a.storage.get(slot, 0) if a else 0
+
+    def storage_set(self, addr: bytes, slot: bytes, value: int):
+        st = self.account(addr).storage
+        if value:
+            st[slot] = value
+        else:
+            st.pop(slot, None)
+
     def set_validator(self, wrapper: ValidatorWrapper):
         self.account(wrapper.address).validator = wrapper
 
@@ -156,24 +188,59 @@ class StateDB:
 
     # -- root --------------------------------------------------------------
 
-    def root(self) -> bytes:
-        """keccak over sorted (address, account) serializations."""
-        out = bytearray()
+    def _live_accounts(self):
         for addr in sorted(self._accounts):
             acct = self._accounts[addr]
-            if acct.balance == 0 and acct.nonce == 0 and not acct.validator:
+            if (acct.balance == 0 and acct.nonce == 0
+                    and not acct.validator and not acct.code
+                    and not acct.storage):
                 continue  # empty accounts don't affect the root
+            yield addr, acct
+
+    def root(self) -> bytes:
+        """keccak over sorted (address, account) serializations — the
+        flat fast path (O(n), one pass, no trie construction)."""
+        out = bytearray()
+        for addr, acct in self._live_accounts():
             out += _enc_bytes(addr) + _enc_bytes(acct.encode())
         return keccak256(bytes(out))
+
+    def mpt_root(self) -> bytes:
+        """Ethereum-SHAPED commitment over the same data: a secure MPT
+        whose leaves are RLP([nonce, balance, storage_root, code_hash,
+        validator_hash]) keyed by keccak(address) — per-account storage
+        committed through its own trie (reference: core/state +
+        go-ethereum trie; the extra validator_hash field carries the
+        staking state the reference keeps in ValidatorWrapper storage).
+        Execution stays flat; this root exists for reference-shaped
+        interop and inclusion proofs."""
+        from .. import rlp
+        from .trie import EMPTY_ROOT, secure_trie_root, trie_root
+
+        items = {}
+        for addr, acct in self._live_accounts():
+            if acct.storage:
+                storage_root = secure_trie_root({
+                    k: rlp.encode(rlp.int_to_bytes(v))
+                    for k, v in acct.storage.items() if v
+                })
+            else:
+                storage_root = EMPTY_ROOT
+            code_hash = keccak256(acct.code)
+            val_hash = keccak256(
+                acct.validator.encode() if acct.validator else b""
+            )
+            items[addr] = rlp.encode([
+                acct.nonce, acct.balance, storage_root, code_hash,
+                val_hash,
+            ])
+        return secure_trie_root(items)
 
     # -- persistence -------------------------------------------------------
 
     def serialize(self) -> bytes:
         out = bytearray()
-        live = [
-            (a, acct) for a, acct in sorted(self._accounts.items())
-            if acct.balance or acct.nonce or acct.validator
-        ]
+        live = list(self._live_accounts())
         out += _enc_int(len(live), 4)
         for addr, acct in live:
             out += _enc_bytes(addr) + _enc_bytes(acct.encode())
@@ -218,4 +285,10 @@ def _decode_account(blob: bytes) -> Account:
             address, keys, rates[0], rates[1], rates[2], rates[3],
             rates[4], delegations, signed, to_sign, status, last_epoch,
         )
-    return Account(balance, nonce, validator)
+    code, storage = b"", {}
+    if not r.eof() and r.int_(1):
+        code = r.bytes_()
+        for _ in range(r.int_(4)):
+            slot = r.bytes_()
+            storage[slot] = r.big_()
+    return Account(balance, nonce, validator, code, storage)

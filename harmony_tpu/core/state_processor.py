@@ -2,15 +2,18 @@
 
 The role of the reference's core/state_processor.go (699 LoC: tx,
 staking-tx, and incoming-CXReceipt application) plus the staking
-message validation of core/staking_verifier.go (SURVEY.md §2.4).  The
-EVM itself is out of the v1 execution scope (SURVEY.md §7 non-goals);
-``data`` payloads are carried, charged for, and ignored.
+message validation of core/staking_verifier.go (SURVEY.md §2.4).
+Contract transactions execute through core/vm.py (the interpreter
+replacing the reference's go-ethereum EVM fork): ``to=None`` deploys,
+a coded ``to`` runs a message call; EVM failures follow Ethereum
+semantics — the tx is included with status 0, the fee is charged, the
+nonce advances, the value stays with the sender.
 
 Gas model (the subset consensus needs to be deterministic about):
 intrinsic 21_000 per plain tx + 68/non-zero byte + 4/zero byte of
-data; staking directives cost a flat intrinsic each.  Fees are burned
-here (reward issuance is the engine's job at Finalize, as in the
-reference's reward.go).
+data, plus the EVM's per-opcode metering (core/vm.py); refunds capped
+at used//2.  Fees are burned here (reward issuance is the engine's job
+at Finalize, as in the reference's reward.go).
 """
 
 from __future__ import annotations
@@ -58,6 +61,7 @@ class StateProcessor:
     def __init__(self, chain_id: int, shard_id: int):
         self.chain_id = chain_id
         self.shard_id = shard_id
+        self._env = None  # block-level EVM context, set per process()
 
     # -- plain transactions ------------------------------------------------
 
@@ -78,14 +82,17 @@ class StateProcessor:
         gas = intrinsic_gas(tx)
         if tx.gas_limit < gas:
             raise ExecutionError("gas limit below intrinsic gas")
-        fee = gas * tx.gas_price
-        total = fee + tx.value
-        if state.balance(sender) < total:
+        if state.balance(sender) < tx.gas_limit * tx.gas_price + tx.value:
             raise ExecutionError("insufficient balance for value + fee")
-        state.sub_balance(sender, total)
-        state.set_nonce(sender, tx.nonce + 1)
+
         cx = None
+        status = 1
+        used = gas
         if tx.is_cross_shard():
+            # cross-shard: value-transfer only (the reference routes no
+            # contract execution across shards); data charged, ignored
+            state.sub_balance(sender, gas * tx.gas_price + tx.value)
+            state.set_nonce(sender, tx.nonce + 1)
             cx = CXReceipt(
                 tx_hash=tx.hash(self.chain_id),
                 sender=sender,
@@ -95,15 +102,66 @@ class StateProcessor:
                 to_shard=tx.to_shard,
                 block_num=block_num,
             )
-        elif tx.to is not None:
-            state.add_balance(tx.to, tx.value)
+        elif tx.to is None or state.code(tx.to) or (
+            tx.data and self._is_precompile(tx.to)
+        ):
+            # EVM path: deploy (to=None) or message call into code.
+            # Fee bought upfront at the gas limit, unused gas refunded
+            # after — Ethereum semantics; an EVM failure keeps the tx
+            # in the block with status 0, fee charged, nonce advanced.
+            from .vm import EVM, Env
+
+            state.sub_balance(sender, tx.gas_limit * tx.gas_price)
+            env = self._env if self._env is not None else Env(
+                block_num=block_num, chain_id=self.chain_id
+            )
+            evm = EVM(state, env, origin=sender, gas_price=tx.gas_price)
+            if tx.to is None:
+                # evm.create advances the nonce and derives the address
+                # from the pre-increment value (tx.nonce)
+                ok, gas_left, _addr = evm.create(
+                    sender, tx.value, tx.data, tx.gas_limit - gas
+                )
+            else:
+                state.set_nonce(sender, tx.nonce + 1)
+                ok, gas_left, _out = evm.call(
+                    sender, tx.to, tx.value, tx.data, tx.gas_limit - gas
+                )
+            status = 1 if ok else 0
+            used = tx.gas_limit - gas_left
+            refund = min(evm.refund if ok else 0, used // 2)
+            used -= refund
+            state.add_balance(
+                sender, (tx.gas_limit - used) * tx.gas_price
+            )
+        else:
+            state.sub_balance(sender, gas * tx.gas_price + tx.value)
+            state.set_nonce(sender, tx.nonce + 1)
+            if tx.to is not None:
+                state.add_balance(tx.to, tx.value)
         receipt = Receipt(
             tx_hash=tx.hash(self.chain_id),
-            status=1,
-            gas_used=gas,
-            cumulative_gas=cumulative_gas + gas,
+            status=status,
+            gas_used=used,
+            cumulative_gas=cumulative_gas + used,
         )
         return receipt, cx
+
+    def set_env(self, env):
+        """Block-level EVM context.  The PROPOSER must set this before
+        speculative execution with the same (block_num, timestamp) it
+        seals into the header — replay rebuilds the env from the header
+        (process()), and any disagreement (e.g. the NUMBER opcode
+        seeing a stale height) would fork the state root."""
+        self._env = env
+
+    @staticmethod
+    def _is_precompile(addr: bytes | None) -> bool:
+        from .vm import PRECOMPILES
+
+        return addr is not None and (
+            int.from_bytes(addr, "big") in PRECOMPILES
+        )
 
     def apply_incoming_receipt(self, state: StateDB, cx: CXReceipt):
         """Credit a cross-shard transfer on its destination shard
@@ -296,6 +354,13 @@ class StateProcessor:
         self, state: StateDB, block, epoch: int
     ) -> ProcessResult:
         """Execute a block against ``state`` (mutates it)."""
+        from .vm import Env
+
+        h = block.header
+        self._env = Env(
+            block_num=h.block_num, timestamp=h.timestamp,
+            chain_id=self.chain_id, epoch=epoch,
+        )
         res = ProcessResult()
         for tx, is_staking in block.ordered_txs():
             if is_staking:

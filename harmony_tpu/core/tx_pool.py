@@ -52,7 +52,7 @@ class TxPool:
             sender = tx.sender(self.chain_id)
         except ValueError as e:
             raise PoolError(f"bad signature: {e}") from e
-        if not is_staking and tx.shard_id != self.shard_id:
+        if tx.shard_id != self.shard_id:
             raise PoolError("wrong shard")
         state = self._state_view()
         if tx.nonce < state.nonce(sender):

@@ -127,25 +127,82 @@ class Registry:
         return "\n".join(m.expose() for m in metrics) + "\n"
 
 
+def _pprof_stacks() -> str:
+    """All-thread stack dump — the role of pprof's goroutine profile
+    (reference: internal/profiler + net/http/pprof wiring in the node;
+    debug=1 text format)."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    threads = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = threads.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        out.append(f"goroutine-analog: {name} (ident {ident})")
+        out.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame)
+        )
+        out.append("")
+    return "\n".join(out)
+
+
+class _Profiler:
+    """CPU profile start/stop (the role of pprof's /debug/pprof/profile,
+    cProfile-based; one profile at a time)."""
+
+    def __init__(self):
+        self._prof = None
+        self._lock = threading.Lock()
+
+    def toggle(self) -> str:
+        import cProfile
+        import io
+        import pstats
+
+        with self._lock:
+            if self._prof is None:
+                self._prof = cProfile.Profile()
+                self._prof.enable()
+                return "profiling started; GET again to stop\n"
+            prof, self._prof = self._prof, None
+            prof.disable()
+            buf = io.StringIO()
+            pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
+            return buf.getvalue()
+
+
 class MetricsServer:
-    """Serves a Registry at GET /metrics (the prometheus service)."""
+    """Serves a Registry at GET /metrics plus pprof-style debug
+    endpoints: /debug/pprof/stacks (all-thread dump) and
+    /debug/pprof/profile (toggle a cProfile run)."""
 
     def __init__(self, registry: Registry, port: int = 0):
         outer_registry = registry
+        profiler = _Profiler()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
             def do_GET(self):
-                if self.path != "/metrics":
+                if self.path == "/metrics":
+                    data = outer_registry.expose().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/pprof/stacks":
+                    data = _pprof_stacks().encode()
+                    ctype = "text/plain"
+                elif self.path == "/debug/pprof/profile":
+                    data = profiler.toggle().encode()
+                    ctype = "text/plain"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                data = outer_registry.expose().encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

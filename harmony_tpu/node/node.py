@@ -45,6 +45,7 @@ from ..consensus.view_change import (
 )
 from ..core import rawdb
 from ..core.blockchain import ChainError
+from ..log import get_logger
 from ..multibls import PrivateKeys
 from ..p2p import consensus_topic
 from ..p2p.host import ACCEPT, IGNORE
@@ -86,6 +87,7 @@ class Node:
         self._prepared_proof: bytes | None = None  # [sig||bitmap] seen
         self._prepared_block_bytes: bytes = b""
 
+        self.log = get_logger("consensus", shard=self.chain.shard_id)
         self.host.add_validator(self.topic, self._gossip_validator)
         self.host.subscribe(self.topic, self._on_gossip)
         self._new_round()
@@ -205,6 +207,11 @@ class Node:
         self._pending_block = block
         self._proposed = True
         msg = self.leader.announce(block.hash(), block_bytes)
+        self.log.info(
+            "announce", block=block.block_num, view=self.view_id,
+            hash=block.hash().hex()[:16],
+            txs=len(block.transactions) + len(block.staking_transactions),
+        )
         self._broadcast(msg, retry=True)
         # a leader whose own keys already meet quorum (single-operator
         # committee) must advance without waiting for external votes
@@ -362,6 +369,10 @@ class Node:
             prepared = self.leader.try_prepared(block_hash)
             if prepared is not None:
                 self._sent_prepared = True
+                self.log.info(
+                    "prepared quorum", block=self.block_num,
+                    view=self.view_id,
+                )
                 self._broadcast(prepared, retry=True)
                 # leader self-commits with its own keys
                 # (reference: threshold.go:53-69)
@@ -417,6 +428,10 @@ class Node:
         }
         if len(self.pending_double_signs) < 64:
             self.pending_double_signs.append(evidence)
+        self.log.warn(
+            "double sign detected", height=msg.block_num,
+            view=msg.view_id, keys=len(msg.sender_pubkeys),
+        )
         if self.webhooks is not None:
             self.webhooks.fire("double_sign", evidence)
 
@@ -480,8 +495,15 @@ class Node:
                 [block], commit_sigs=[msg.payload],
                 verify_seals=self.chain.engine is not None,
             )
-        except ChainError:
+        except ChainError as e:
+            self.log.error(
+                "commit insert failed", block=block.block_num, err=str(e)
+            )
             return
+        self.log.info(
+            "committed", block=block.block_num, view=self.view_id,
+            hash=block.hash().hex()[:16],
+        )
         if self.pool is not None:
             self.pool.drop_applied()
         self.sender.stop_retry(block.block_num)
@@ -501,6 +523,10 @@ class Node:
         head = self.chain.current_header()
         new_view = head.view_id + 1 + self._vc
         self.in_view_change = True
+        self.log.warn(
+            "view change start", block=self.block_num, new_view=new_view,
+            had_prepared=self._prepared_proof is not None,
+        )
         prepared_hash = None
         if self._prepared_proof is not None and self._pending_block is not None:
             prepared_hash = self._pending_block.hash()
@@ -613,6 +639,10 @@ class Node:
         the carried prepared block, or proposes fresh."""
         head = self.chain.current_header()
         self._vc = max(new_view - head.view_id - 1, 0)
+        self.log.info(
+            "adopt new view", new_view=new_view, block=self.block_num,
+            carried_block=bool(nv.m1_payload),
+        )
         reproposal = None
         if nv.m1_payload and block_bytes:
             try:

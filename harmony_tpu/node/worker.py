@@ -47,6 +47,13 @@ class Worker:
         outgoing = []
         state = self.chain.state().copy()
         gas_used = 0
+        # EVM context must match what replay derives from the header
+        from ..core.vm import Env
+
+        self.chain.processor.set_env(Env(
+            block_num=num, timestamp=timestamp,
+            chain_id=self.chain.config.chain_id, epoch=epoch,
+        ))
         if self.tx_pool is not None:
             for tx, is_staking in self.tx_pool.pending(max_txs):
                 try:

@@ -224,6 +224,53 @@ def fp12_conj(a):
     return jnp.stack([a0, fp.neg(a1)], axis=-4)
 
 
+def fp12_cyclo_sqr(a):
+    """Granger-Scott squaring for UNITARY elements (the cyclotomic
+    subgroup every final-exp intermediate lives in after the easy part):
+    9 Fp2 squarings in ONE stacked mont_mul — 18 Fp products vs the 36
+    of the generic fp12_sqr.
+
+    Derivation: with w^2 = v, v^3 = xi the tower is also
+    Fp12 = Fp2[w]/(w^6 - xi); for unitary z the Fp4 squarings collapse
+    to the 6-coefficient identities below (c_i are the Fp2 coefficients
+    z = (c0 + c1 v + c2 v^2) + (c3 + c4 v + c5 v^2) w):
+
+        t0 = xi c4^2 + c0^2        z0' = 3 t0 - 2 c0
+        t2 = xi c3^2 ... (see code; verified against fp12_sqr on
+        unitary inputs in tests/test_ops_towers.py)
+    """
+    c0 = a[..., 0, 0, :, :]
+    c1 = a[..., 0, 1, :, :]
+    c2 = a[..., 0, 2, :, :]
+    c3 = a[..., 1, 0, :, :]
+    c4 = a[..., 1, 1, :, :]
+    c5 = a[..., 1, 2, :, :]
+    # 9 independent Fp2 squarings, one stacked call
+    sq = fp2_sqr(jnp.stack([
+        c4, c0, fp.add(c4, c0),
+        c3, c2, fp.add(c3, c2),
+        c5, c1, fp.add(c5, c1),
+    ], axis=0))
+    s_c4, s_c0, s_40 = sq[0], sq[1], sq[2]
+    s_c3, s_c2, s_32 = sq[3], sq[4], sq[5]
+    s_c5, s_c1, s_51 = sq[6], sq[7], sq[8]
+    t6 = fp.sub(s_40, fp.add(s_c4, s_c0))  # 2 c0 c4
+    t7 = fp.sub(s_32, fp.add(s_c3, s_c2))  # 2 c2 c3
+    t8 = fp2_mul_xi(fp.sub(s_51, fp.add(s_c5, s_c1)))  # 2 xi c1 c5
+    t0 = fp.add(fp2_mul_xi(s_c4), s_c0)  # xi c4^2 + c0^2
+    t2 = fp.add(fp2_mul_xi(s_c2), s_c3)  # xi c2^2 + c3^2
+    t4 = fp.add(fp2_mul_xi(s_c5), s_c1)  # xi c5^2 + c1^2
+    z0 = fp.add(fp.add(fp.sub(t0, c0), fp.sub(t0, c0)), t0)
+    z1 = fp.add(fp.add(fp.sub(t2, c1), fp.sub(t2, c1)), t2)
+    z2 = fp.add(fp.add(fp.sub(t4, c2), fp.sub(t4, c2)), t4)
+    z3 = fp.add(fp.add(fp.add(t8, c3), fp.add(t8, c3)), t8)
+    z4 = fp.add(fp.add(fp.add(t6, c4), fp.add(t6, c4)), t6)
+    z5 = fp.add(fp.add(fp.add(t7, c5), fp.add(t7, c5)), t7)
+    lo = jnp.stack([z0, z1, z2], axis=-3)
+    hi = jnp.stack([z3, z4, z5], axis=-3)
+    return jnp.stack([lo, hi], axis=-4)
+
+
 def fp12_inv(a):
     a0, a1 = _split12(a)
     sq = fp6_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))

@@ -26,8 +26,11 @@ import threading
 import time
 from collections import OrderedDict
 
+from ..log import get_logger
 from ..ref.keccak import keccak256
 from .gating import Gater
+
+_log = get_logger("p2p")
 
 MAX_MESSAGE_BYTES = 2 * 1024 * 1024  # reference: p2p/host.go:98-99
 _FRAME = struct.Struct("<IB")
@@ -227,6 +230,9 @@ class TCPHost(Host):
             peer_name = (self._recv_exact(sock, ln) or b"").decode()
             with self._peer_lock:
                 self._peers[sock] = peer_name
+            _log.info(
+                "peer connected", me=self.name, peer=peer_name, ip=ip
+            )
             while not self._closing:
                 hdr = self._recv_exact(sock, _FRAME.size)
                 if hdr is None:
@@ -243,7 +249,9 @@ class TCPHost(Host):
             pass
         finally:
             with self._peer_lock:
-                self._peers.pop(sock, None)
+                dropped = self._peers.pop(sock, None)
+            if dropped is not None and not self._closing:
+                _log.info("peer disconnected", me=self.name, peer=dropped)
             self.gater.release(ip)
             try:
                 sock.close()

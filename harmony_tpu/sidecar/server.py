@@ -164,17 +164,53 @@ class SidecarServer:
             )
         )
 
+    # pinned device batch widths (shared compiled programs; chunked
+    # above the widest — same bucketing discipline as chain/engine.py)
+    _VERIFY_BUCKETS = (8, 64)
+
     def _on_verify_batch(self, body):
+        """Batched independent verifies — ONE device program per chunk
+        (the r1 version looped host bigint pairings one at a time; the
+        batched ops path is the op this service exists to serve)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import bls as OB
+        from ..ops import interop as I
+
         items = P.parse_verify_batch(body)
-        results = bytearray()
+        results = bytearray(len(items))
+        survivors = []  # (index, pk_point, h_point, sig_point)
+        for idx, (pk_bytes, payload, sig_bytes) in enumerate(items):
+            try:
+                pk = RB.pubkey_from_bytes(pk_bytes)
+                sig = RB.sig_from_bytes(sig_bytes)
+            except ValueError:
+                continue
+            if sig is None:
+                continue
+            survivors.append((idx, pk, hash_to_g2(payload), sig))
+        widest = self._VERIFY_BUCKETS[-1]
         with self._exec_lock:
-            for pk_bytes, payload, sig_bytes in items:
-                ok = False
-                try:
-                    pk = RB.pubkey_from_bytes(pk_bytes)
-                    sig = RB.sig_from_bytes(sig_bytes)
-                    ok = RB.verify(pk, payload, sig)
-                except ValueError:
-                    ok = False
-                results.append(1 if ok else 0)
+            for start in range(0, len(survivors), widest):
+                chunk = survivors[start:start + widest]
+                n = len(chunk)
+                padded = next(
+                    (b for b in self._VERIFY_BUCKETS if n <= b), widest
+                )
+                sel = list(range(n)) + [0] * (padded - n)
+                pk = np.asarray(
+                    I.g1_batch_affine([chunk[i][1] for i in sel])
+                )
+                hh = np.asarray(
+                    I.g2_batch_affine([chunk[i][2] for i in sel])
+                )
+                sg = np.asarray(
+                    I.g2_batch_affine([chunk[i][3] for i in sel])
+                )
+                ok = np.asarray(OB.verify(
+                    jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg)
+                ))[:n]
+                for (idx, _, _, _), good in zip(chunk, ok):
+                    results[idx] = 1 if bool(good) else 0
         return P.STATUS_OK, len(items).to_bytes(4, "little") + bytes(results)

@@ -16,7 +16,11 @@ from __future__ import annotations
 from collections import Counter
 from dataclasses import dataclass, field
 
+from ..log import get_logger
+
 BATCH = 64  # blocks per fetch/verify window
+
+_log = get_logger("sync")
 
 
 @dataclass
@@ -98,6 +102,11 @@ class Downloader:
     def sync_once(self) -> SyncResult:
         """One pass to the current network head."""
         res = SyncResult(target=self.network_head())
+        if res.target > self.chain.head_number:
+            _log.info(
+                "sync start", head=self.chain.head_number,
+                target=res.target, peers=len(self.clients),
+            )
         while self.chain.head_number < res.target:
             start = self.chain.head_number + 1
             count = min(self.batch, res.target - self.chain.head_number)
@@ -118,4 +127,9 @@ class Downloader:
             except ValueError as e:
                 res.errors.append(f"insert failed at {start}: {e}")
                 break
+        if res.inserted or res.errors:
+            _log.info(
+                "sync pass done", inserted=res.inserted,
+                head=self.chain.head_number, errors=len(res.errors),
+            )
         return res

@@ -1,0 +1,182 @@
+"""EVM interpreter: deploy, call, storage, revert, precompiles, and the
+state_processor contract path (reference: core/vm)."""
+
+import pytest
+
+from harmony_tpu.core.state import StateDB
+from harmony_tpu.core.state_processor import ExecutionError, StateProcessor
+from harmony_tpu.core.types import Transaction
+from harmony_tpu.core.vm import (
+    EVM,
+    Env,
+    create_address,
+    create2_address,
+)
+from harmony_tpu.crypto_ecdsa import ECDSAKey
+from harmony_tpu.ref.keccak import keccak256
+
+# runtime: no calldata -> return sload(0); calldata -> sstore(0, word0)
+RUNTIME = bytes([
+    0x36, 0x15, 0x60, 0x0C, 0x57,            # calldatasize iszero jumpi
+    0x60, 0x00, 0x35, 0x60, 0x00, 0x55,      # sstore(0, calldataload(0))
+    0x00,                                    # stop
+    0x5B, 0x60, 0x00, 0x54,                  # jumpdest; sload(0)
+    0x60, 0x00, 0x52,                        # mstore(0, val)
+    0x60, 0x20, 0x60, 0x00, 0xF3,            # return(0, 32)
+])
+
+# init: codecopy(0, 12, len(RUNTIME)); return(0, len(RUNTIME))
+INIT = bytes([
+    0x60, len(RUNTIME), 0x60, 0x0C, 0x60, 0x00, 0x39,
+    0x60, len(RUNTIME), 0x60, 0x00, 0xF3,
+]) + RUNTIME
+
+REVERTER = bytes([0x60, 0x00, 0x60, 0x00, 0xFD])  # revert(0, 0)
+
+A = b"\xaa" * 20
+
+
+def _evm(state):
+    return EVM(state, Env(block_num=5, chain_id=2), origin=A, gas_price=1)
+
+
+def test_deploy_and_call_roundtrip():
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    ok, gas_left, addr = evm.create(A, 0, INIT, 1_000_000)
+    assert ok and gas_left > 0
+    assert state.code(addr) == RUNTIME
+    assert addr == create_address(A, 0)
+
+    # write 0x2a via calldata
+    ok, _, out = evm.call(A, addr, 0, (42).to_bytes(32, "big"), 500_000)
+    assert ok
+    assert state.storage_get(addr, b"\x00" * 32) == 42
+    # read it back
+    ok, _, out = evm.call(A, addr, 0, b"", 500_000)
+    assert ok and int.from_bytes(out, "big") == 42
+
+
+def test_revert_unwinds_state_and_reports():
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    ok, _, addr = evm.create(A, 0, INIT + b"", 1_000_000)
+    ok, _, raddr = evm.create(
+        A, 0, bytes([0x60, len(REVERTER), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                     0x60, len(REVERTER), 0x60, 0x00, 0xF3]) + REVERTER,
+        1_000_000,
+    )
+    assert ok
+    ok, gas_left, out = evm.call(A, raddr, 0, b"", 100_000)
+    assert not ok and gas_left > 0  # revert refunds remaining gas
+
+
+def test_value_transfer_through_call():
+    state = StateDB()
+    state.add_balance(A, 1000)
+    evm = _evm(state)
+    to = b"\xbb" * 20
+    ok, _, _ = evm.call(A, to, 250, b"", 100_000)
+    assert ok
+    assert state.balance(to) == 250 and state.balance(A) == 750
+
+
+def test_create2_address():
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    salt = b"\x07" * 32
+    ok, _, addr = evm.create(A, 0, INIT, 1_000_000, salt=salt)
+    assert ok
+    assert addr == create2_address(A, salt, INIT)
+
+
+def test_precompiles():
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    # identity (0x04)
+    ok, _, out = evm.call(A, b"\x00" * 19 + b"\x04", 0, b"hello", 100_000)
+    assert ok and out == b"hello"
+    # sha256 (0x02)
+    import hashlib
+
+    ok, _, out = evm.call(A, b"\x00" * 19 + b"\x02", 0, b"x", 100_000)
+    assert ok and out == hashlib.sha256(b"x").digest()
+    # modexp (0x05): 3^4 mod 5 = 1
+    data = (
+        (1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+        + (1).to_bytes(32, "big") + b"\x03\x04\x05"
+    )
+    ok, _, out = evm.call(A, b"\x00" * 19 + b"\x05", 0, data, 100_000)
+    assert ok and out == b"\x01"
+    # ecrecover (0x01) against our own signer
+    key = ECDSAKey.from_seed(b"\x11")
+    h = keccak256(b"message")
+    sig = key.sign(h)  # [R||S||V(0/1)]
+    data = h + (27 + sig[64]).to_bytes(32, "big") + sig[:64]
+    ok, _, out = evm.call(A, b"\x00" * 19 + b"\x01", 0, data, 100_000)
+    assert ok and out[12:] == key.address()
+    # bn256 pairing (0x08) fails by design
+    ok, _, _ = evm.call(A, b"\x00" * 19 + b"\x08", 0, b"", 100_000)
+    assert not ok
+
+
+def test_processor_contract_path():
+    """Deploy + interact through real signed transactions."""
+    key = ECDSAKey.from_seed(b"\x22")
+    sender = key.address()
+    state = StateDB()
+    state.add_balance(sender, 10**18)
+    proc = StateProcessor(chain_id=2, shard_id=0)
+
+    deploy = Transaction(
+        nonce=0, gas_price=1, gas_limit=1_000_000, shard_id=0,
+        to_shard=0, to=None, value=0, data=INIT,
+    ).sign(key, 2)
+    receipt, cx = proc.apply_transaction(state, deploy, 1, 0)
+    assert receipt.status == 1 and cx is None
+    addr = create_address(sender, 0)
+    assert state.code(addr) == RUNTIME
+    assert state.nonce(sender) == 1
+    assert receipt.gas_used > 21_000  # intrinsic + create + execution
+
+    call = Transaction(
+        nonce=1, gas_price=1, gas_limit=200_000, shard_id=0,
+        to_shard=0, to=addr, value=0, data=(7).to_bytes(32, "big"),
+    ).sign(key, 2)
+    receipt, _ = proc.apply_transaction(state, call, 2, 0)
+    assert receipt.status == 1
+    assert state.storage_get(addr, b"\x00" * 32) == 7
+
+    # plain transfer to the contract-free address still works
+    xfer = Transaction(
+        nonce=2, gas_price=1, gas_limit=25_000, shard_id=0,
+        to_shard=0, to=b"\x0c" * 20, value=5,
+    ).sign(key, 2)
+    receipt, _ = proc.apply_transaction(state, xfer, 3, 0)
+    assert receipt.status == 1 and state.balance(b"\x0c" * 20) == 5
+
+    # out-of-gas contract call: included with status 0, fee charged,
+    # nonce advanced, storage untouched
+    bal_before = state.balance(sender)
+    oog = Transaction(
+        nonce=3, gas_price=1, gas_limit=21_200, shard_id=0,
+        to_shard=0, to=addr, value=0, data=(9).to_bytes(32, "big"),
+    ).sign(key, 2)
+    receipt, _ = proc.apply_transaction(state, oog, 4, 0)
+    assert receipt.status == 0
+    assert state.nonce(sender) == 4
+    assert state.storage_get(addr, b"\x00" * 32) == 7  # unchanged
+    assert state.balance(sender) == bal_before - receipt.gas_used
+
+    # deterministic root across an independent replay
+    state2 = StateDB()
+    state2.add_balance(sender, 10**18)
+    proc2 = StateProcessor(chain_id=2, shard_id=0)
+    for i, tx in enumerate((deploy, call, xfer, oog)):
+        proc2.apply_transaction(state2, tx, i + 1, 0)
+    assert state2.root() == state.root()
+    assert state2.mpt_root() == state.mpt_root()

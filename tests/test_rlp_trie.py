@@ -1,0 +1,118 @@
+"""RLP codec + Merkle-Patricia trie (reference: go-ethereum rlp/trie
+packages; yellow-paper appendices B-D)."""
+
+import pytest
+
+from harmony_tpu import rlp
+from harmony_tpu.core.trie import (
+    EMPTY_ROOT,
+    Trie,
+    secure_trie_root,
+    trie_root,
+)
+from harmony_tpu.ref.keccak import keccak256
+
+
+def test_rlp_known_vectors():
+    # yellow-paper / ethereum wiki canonical vectors
+    assert rlp.encode(b"dog") == b"\x83dog"
+    assert rlp.encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp.encode(b"") == b"\x80"
+    assert rlp.encode([]) == b"\xc0"
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+    # set theoretical representation of three
+    assert rlp.encode([[], [[]], [[], [[]]]]) == bytes.fromhex(
+        "c7c0c1c0c3c0c1c0"
+    )
+    lorem = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp.encode(lorem) == b"\xb8\x38" + lorem
+
+
+def test_rlp_roundtrip_and_strictness():
+    for item in (b"", b"\x00", b"\x7f", b"\x80", b"x" * 55, b"y" * 56,
+                 [b"a", [b"b", b"c"], b""], [b"z" * 100, [b"w"] * 20]):
+        assert rlp.decode(rlp.encode(item)) == item
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x81\x01")  # single byte <0x80 wrapped as string
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\xb8\x01x")  # long form for short length
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x83do")  # truncated
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x83dogX")  # trailing bytes
+
+
+def test_trie_known_roots():
+    # the canonical empty root
+    assert EMPTY_ROOT == bytes.fromhex(
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    )
+    # single entry trie vs hand-derived leaf encoding
+    t = Trie()
+    t.update(b"A", b"aaaa")
+    # leaf: [HP([4,1], leaf), b"aaaa"] -> rlp -> keccak
+    expect = keccak256(rlp.encode([b"\x20\x41", b"aaaa"]))
+    assert t.root() == expect
+
+
+def test_trie_go_ethereum_vector():
+    """The classic go-ethereum TestInsert vector."""
+    t = Trie()
+    t.update(b"doe", b"reindeer")
+    t.update(b"dog", b"puppy")
+    t.update(b"dogglesworth", b"cat")
+    assert t.root() == bytes.fromhex(
+        "8aad789dff2f538bca5d8ea56e8abe10f4c7ba3a5dea95fea4cd6e7c3a1168d3"
+    )
+    t2 = Trie()
+    t2.update(b"A", b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa")
+    assert t2.root() == bytes.fromhex(
+        "d23786fb4a010da3ce639d66d5e904a11dbc02746d1ce25029e53290cabf28ab"
+    )
+
+
+def test_trie_order_independence_and_delete():
+    import random
+
+    items = {
+        bytes([i]) * (1 + i % 7): bytes([i ^ 0x5A]) * (1 + i % 11)
+        for i in range(40)
+    }
+    base = trie_root(items)
+    keys = list(items)
+    random.Random(7).shuffle(keys)
+    t = Trie()
+    for k in keys:
+        t.update(k, items[k])
+    assert t.root() == base
+    # deleting (empty value) = absent
+    t.update(keys[0], b"")
+    reduced = dict(items)
+    del reduced[keys[0]]
+    assert t.root() == trie_root(reduced)
+
+
+def test_secure_trie_and_state_mpt_root():
+    items = {b"\x01" * 20: b"acct1", b"\x02" * 20: b"acct2"}
+    assert secure_trie_root(items) == trie_root(
+        {keccak256(k): v for k, v in items.items()}
+    )
+
+    from harmony_tpu.core.state import StateDB
+
+    s = StateDB()
+    s.add_balance(b"\x0a" * 20, 1000)
+    s.set_nonce(b"\x0a" * 20, 3)
+    s.set_code(b"\x0b" * 20, b"\x60\x00")
+    s.storage_set(b"\x0b" * 20, b"\x00" * 32, 42)
+    r1 = s.mpt_root()
+    assert len(r1) == 32 and r1 != EMPTY_ROOT
+    # storage affects the root through the per-account trie
+    s.storage_set(b"\x0b" * 20, b"\x00" * 32, 43)
+    assert s.mpt_root() != r1
+    # flat root and mpt root both see the same data
+    s2 = s.copy()
+    assert s2.mpt_root() == s.mpt_root()
+    assert s2.root() == s.root()

@@ -1,0 +1,68 @@
+"""Microbenchmark: mont_mul scan (fp.py) vs Pallas kernel (fp_pallas.py)
+on the current default JAX platform, at pairing-realistic shapes.
+
+Usage: python tools/bench_montmul.py [rows ...]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(fn, args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    sys.path.insert(0, ".")
+    from harmony_tpu.ops import fp
+    from harmony_tpu.ops.fp_pallas import mont_mul_pallas
+
+    rows_list = [int(x) for x in sys.argv[1:]] or [1024, 16384, 55296]
+    chain = 64  # muls chained inside ONE jit: amortizes dispatch latency
+    rng = np.random.default_rng(0)
+
+    def chained(mul):
+        def fn(a, b):
+            c = a
+            for _ in range(chain):
+                c = mul(c, b)
+            return c
+        return fn
+
+    for rows in rows_list:
+        a = jnp.asarray(
+            rng.integers(0, 4096, size=(rows, 32), dtype=np.int32)
+        )
+        b = jnp.asarray(
+            rng.integers(0, 4096, size=(rows, 32), dtype=np.int32)
+        )
+        scan_fn = jax.jit(chained(fp.mont_mul))
+        t_scan = bench(scan_fn, (a, b)) / chain
+        try:
+            pallas_fn = jax.jit(chained(mont_mul_pallas))
+            t_pal = bench(pallas_fn, (a, b)) / chain
+            same = bool(jnp.all(scan_fn(a, b) == pallas_fn(a, b)))
+        except Exception as e:  # noqa: BLE001
+            t_pal, same = float("nan"), f"ERR {type(e).__name__}: {e}"
+        mps = rows / t_pal / 1e6 if t_pal == t_pal else 0
+        print(
+            f"rows={rows}: scan {t_scan*1e6:.0f}us "
+            f"pallas {t_pal*1e6:.0f}us ({t_scan/t_pal:.1f}x, "
+            f"{mps:.0f}M muls/s) match={same}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
