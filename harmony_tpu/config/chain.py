@@ -22,6 +22,16 @@ class ChainConfig:
     leader_rotation_epoch: int | None = None
     epos_bound_v2_epoch: int | None = None  # extended 0.35 EPoS bound
     cross_shard_epoch: int | None = 0
+    # header version thresholds (reference: the block factory picks the
+    # header version by epoch via internal/params gates feeding
+    # block/factory; v0 is the genesis-era legacy encoding)
+    header_v1_epoch: int | None = 0
+    header_v2_epoch: int | None = 0
+    header_v3_epoch: int | None = 0
+    # MPT state root in headers (reference: headers always commit the
+    # secure-trie root, core/state; gated here so legacy flat-root
+    # chains replay)
+    mpt_root_epoch: int | None = 0
     extra: dict = field(default_factory=dict)  # name -> epoch threshold
 
     @staticmethod
@@ -42,6 +52,24 @@ class ChainConfig:
 
     def is_cross_shard(self, epoch: int) -> bool:
         return self._active(self.cross_shard_epoch, epoch)
+
+    def header_version(self, epoch: int) -> str:
+        """The header version new proposals use at this epoch."""
+        for ver, thr in (("v3", self.header_v3_epoch),
+                         ("v2", self.header_v2_epoch),
+                         ("v1", self.header_v1_epoch)):
+            if self._active(thr, epoch):
+                return ver
+        return "v0"
+
+    def is_mpt_root(self, epoch: int) -> bool:
+        return self._active(self.mpt_root_epoch, epoch)
+
+    def state_root(self, state, epoch: int) -> bytes:
+        """The root headers commit at this epoch: the secure-trie MPT
+        root once gated (reference semantics), else the legacy flat
+        root."""
+        return state.mpt_root() if self.is_mpt_root(epoch) else state.root()
 
     def is_active(self, name: str, epoch: int) -> bool:
         """Generic gate lookup for features carried in ``extra``."""

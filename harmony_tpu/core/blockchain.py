@@ -16,6 +16,8 @@ per block, batched across an insert).
 
 from __future__ import annotations
 
+import threading
+
 from ..chain.header import Header
 from .genesis import Genesis
 from .state import StateDB
@@ -95,6 +97,10 @@ class Blockchain:
         self.blocks_per_epoch = blocks_per_epoch
         self.processor = StateProcessor(self.config.chain_id, self.shard_id)
         self._committee_cache: dict[int, list] = {}
+        # insert_chain can be reached from two threads at once: the
+        # consensus pump (commit path) and the background downloader
+        # (node._spin_up_sync) — serialize writers
+        self._insert_lock = threading.RLock()
         head = rawdb.read_head_number(db)
         if head is None:
             self._init_genesis()
@@ -244,7 +250,7 @@ class Blockchain:
             state, block.block_num, epoch,
             block.header.last_commit_bitmap or None,
         )
-        if state.root() != block.header.root:
+        if self.config.state_root(state, epoch) != block.header.root:
             raise ChainError("state root mismatch after execution")
         return state, result, elected
 
@@ -282,8 +288,26 @@ class Blockchain:
         """
         if not blocks:
             return 0
+        with self._insert_lock:
+            return self._insert_chain_locked(
+                blocks, commit_sigs, verify_seals
+            )
+
+    def _insert_chain_locked(self, blocks, commit_sigs, verify_seals):
         if commit_sigs is None:
             commit_sigs = [None] * len(blocks)
+
+        # blocks the OTHER writer already landed are skipped
+        # idempotently (a sync pass and a consensus commit can race to
+        # the same height); proofs stay aligned with their blocks
+        pairs = [
+            (b, s) for b, s in zip(blocks, commit_sigs)
+            if b.block_num > self.head_number
+        ]
+        if not pairs:
+            return 0
+        blocks = [b for b, _ in pairs]
+        commit_sigs = [s for _, s in pairs]
 
         # structural pass + proof resolution
         parent = self.current_header()
@@ -329,6 +353,10 @@ class Blockchain:
                 self._committee_cache.pop(elected.epoch, None)
             rawdb.write_block(self.db, block, self.config.chain_id)
             rawdb.write_state(self.db, block.header.root, state.serialize())
+            rawdb.write_receipts(
+                self.db, block.block_num,
+                result.receipts + result.staking_receipts,
+            )
             if proof is not None:
                 rawdb.write_commit_sig(self.db, block.block_num, proof)
             by_shard: dict[int, list] = {}

@@ -39,9 +39,10 @@ class Genesis:
             epoch=0,
             view_id=0,
             parent_hash=bytes(32),
-            root=state.root(),
+            root=self.config.state_root(state, 0),
             timestamp=self.timestamp,
             extra=self.extra + b"".join(self.committee),
+            version=self.config.header_version(0),
         )
         return Block(header)
 

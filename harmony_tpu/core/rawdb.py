@@ -29,40 +29,51 @@ _NUM_BY_HASH = b"H"     # H || hash -> num(8)
 _COMMIT_SIG = b"s"      # s || num(8) -> [96B sig || bitmap]
 _HEAD = b"LastBlock"    # -> num(8)
 _STATE = b"S"           # S || root -> serialized StateDB
+_RECEIPTS = b"r"        # r || num(8) -> encoded receipt list
+_RECEIPT_IDX = b"R"     # R || tx_hash -> num(8) (lookup index)
 _CX = b"x"              # x || to_shard(4) || num(8) -> outgoing cx blob
 _CX_SPENT = b"X"        # X || from_shard(4) || num(8) -> spent marker
 
 
 # -- codecs -----------------------------------------------------------------
 
+_HEADER_FIELDS = (
+    # (name, kind) in storage order — every dataclass field, version
+    # included, so the store round-trips any header version losslessly
+    ("version", "str"), ("shard_id", "int"), ("block_num", "int"),
+    ("epoch", "int"), ("view_id", "int"), ("timestamp", "int"),
+    ("parent_hash", "bytes"), ("root", "bytes"), ("tx_root", "bytes"),
+    ("out_cx_root", "bytes"), ("last_commit_sig", "bytes"),
+    ("last_commit_bitmap", "bytes"), ("extra", "bytes"),
+    ("vrf", "bytes"), ("vdf", "bytes"), ("shard_state", "bytes"),
+    ("cross_links", "bytes"), ("slashes", "bytes"),
+)
+
+
 def encode_header(h: Header) -> bytes:
-    return (
-        _enc_bytes(h.signing_fields())
-        + _enc_bytes(h.last_commit_sig)
-        + _enc_bytes(h.last_commit_bitmap)
-    )
+    out = bytearray()
+    for name, kind in _HEADER_FIELDS:
+        v = getattr(h, name)
+        if kind == "int":
+            out += v.to_bytes(8, "little")
+        elif kind == "str":
+            out += _enc_bytes(v.encode())
+        else:
+            out += _enc_bytes(v)
+    return bytes(out)
 
 
 def decode_header(blob: bytes) -> Header:
     r = _Reader(blob)
-    fields = _Reader(r.bytes_())
-    shard_id = fields.int_()
-    block_num = fields.int_()
-    epoch = fields.int_()
-    view_id = fields.int_()
-    timestamp = fields.int_()
-    parent_hash = fields.raw(32)
-    root = fields.raw(32)
-    tx_root = fields.raw(32)
-    out_cx = fields.raw(32)
-    extra = fields.bytes_()
-    return Header(
-        shard_id=shard_id, block_num=block_num, epoch=epoch,
-        view_id=view_id, parent_hash=parent_hash, root=root,
-        tx_root=tx_root, out_cx_root=out_cx, timestamp=timestamp,
-        last_commit_sig=r.bytes_(), last_commit_bitmap=r.bytes_(),
-        extra=extra,
-    )
+    kw = {}
+    for name, kind in _HEADER_FIELDS:
+        if kind == "int":
+            kw[name] = r.int_()
+        elif kind == "str":
+            kw[name] = r.bytes_().decode()
+        else:
+            kw[name] = r.bytes_()
+    return Header(**kw)
 
 
 def encode_tx(tx: Transaction, chain_id: int) -> bytes:
@@ -246,6 +257,31 @@ def write_state(db, root: bytes, state_blob: bytes):
 
 def read_state(db, root: bytes) -> bytes | None:
     return db.get(_STATE + root)
+
+
+def write_receipts(db, num: int, receipts: list):
+    from .types import Receipt  # noqa: F401 — encoded via Receipt.encode
+
+    out = bytearray(_enc_int(len(receipts), 4))
+    for rc in receipts:
+        out += rc.encode()
+        db.put(_RECEIPT_IDX + rc.tx_hash, _enc_int(num))
+    db.put(_RECEIPTS + _enc_int(num), bytes(out))
+
+
+def read_receipt_block_num(db, tx_hash: bytes) -> int | None:
+    blob = db.get(_RECEIPT_IDX + tx_hash)
+    return int.from_bytes(blob, "little") if blob is not None else None
+
+
+def read_receipts(db, num: int) -> list:
+    from .types import Receipt
+
+    blob = db.get(_RECEIPTS + _enc_int(num))
+    if blob is None:
+        return []
+    r = _Reader(blob)
+    return [Receipt.decode(r) for _ in range(r.int_(4))]
 
 
 def write_outgoing_cx(db, to_shard: int, num: int, cxs: list):

@@ -31,6 +31,8 @@ class Worker:
         incoming_receipts: list | None = None,
         leader_extra: bytes = b"",
         max_txs: int = DEFAULT_BLOCK_TX_CAP,
+        vrf: bytes = b"",
+        vdf: bytes = b"",
     ) -> Block:
         """Assemble the next block on the current tip.
 
@@ -100,12 +102,15 @@ class Worker:
             epoch=epoch,
             view_id=view_id,
             parent_hash=parent.hash(),
-            root=state.root(),
+            root=self.chain.config.state_root(state, epoch),
             tx_root=block.tx_root(self.chain.config.chain_id),
             out_cx_root=out_cx_root(group_cx_by_shard(outgoing)),
             timestamp=timestamp,
             last_commit_sig=last_sig,
             last_commit_bitmap=last_bitmap,
             extra=leader_extra,
+            vrf=vrf,
+            vdf=vdf,
+            version=self.chain.config.header_version(epoch),
         )
         return block

@@ -41,14 +41,43 @@ def _sign_header(header, keys, signer_idx):
     return agg.bytes, mask.mask_bytes()
 
 
-def test_header_hash_excludes_commit_proof():
+def test_header_hash_includes_carried_commit_proof():
+    """Reference semantics (block/v3/header.go:67-68): the PARENT's
+    commit sig/bitmap are ordinary header fields, fixed at proposal —
+    the signed hash commits to them."""
     h = Header(shard_id=0, block_num=5, epoch=1, view_id=5)
     base = h.hash()
     h.last_commit_sig = b"x" * 96
     h.last_commit_bitmap = b"\x0f"
-    assert h.hash() == base  # commit proof must not change the hash
+    assert h.hash() != base  # proof is part of the hashed fields
     h2 = Header(shard_id=0, block_num=6, epoch=1, view_id=5)
     assert h2.hash() != base
+
+
+def test_header_versions_hash_distinctly():
+    kw = dict(shard_id=1, block_num=7, epoch=2, view_id=7)
+    hashes = {Header(version=v, **kw).hash() for v in ("v0", "v1", "v2", "v3")}
+    assert len(hashes) == 4  # tagged envelope separates versions
+    import pytest
+
+    with pytest.raises(ValueError):
+        Header(version="v9", **kw).hash()
+
+
+def test_header_rawdb_roundtrip_all_versions():
+    from harmony_tpu.core import rawdb
+
+    for v in ("v0", "v1", "v2", "v3"):
+        h = Header(
+            shard_id=2, block_num=9, epoch=1, view_id=9,
+            parent_hash=b"\x01" * 32, root=b"\x02" * 32,
+            last_commit_sig=b"s" * 96, last_commit_bitmap=b"\x0f",
+            vrf=b"vrf-bytes", shard_state=b"ss", cross_links=b"cl",
+            slashes=b"sl", version=v,
+        )
+        back = rawdb.decode_header(rawdb.encode_header(h))
+        assert back == h
+        assert back.hash() == h.hash()
 
 
 def test_verify_header_signature_and_cache(committee):
