@@ -117,6 +117,10 @@ class StateDB:
 
     def __init__(self, accounts: dict | None = None):
         self._accounts: dict[bytes, Account] = accounts or {}
+        # EVM frame journaling (go-ethereum StateDB journal shape):
+        # None = off (zero overhead for non-EVM users); a list = every
+        # mutation appends an undo record, revert_to() rolls back.
+        self._jrnl: list | None = None
 
     # -- access ------------------------------------------------------------
 
@@ -125,6 +129,8 @@ class StateDB:
         if acct is None:
             acct = Account()
             self._accounts[addr] = acct
+            if self._jrnl is not None:
+                self._jrnl.append(("new", addr))
         return acct
 
     def balance(self, addr: bytes) -> int:
@@ -136,16 +142,24 @@ class StateDB:
         return a.nonce if a else 0
 
     def add_balance(self, addr: bytes, amount: int):
-        self.account(addr).balance += amount
+        acct = self.account(addr)
+        if self._jrnl is not None:
+            self._jrnl.append(("bal", addr, acct.balance))
+        acct.balance += amount
 
     def sub_balance(self, addr: bytes, amount: int):
         acct = self.account(addr)
         if acct.balance < amount:
             raise ValueError("insufficient balance")
+        if self._jrnl is not None:
+            self._jrnl.append(("bal", addr, acct.balance))
         acct.balance -= amount
 
     def set_nonce(self, addr: bytes, nonce: int):
-        self.account(addr).nonce = nonce
+        acct = self.account(addr)
+        if self._jrnl is not None:
+            self._jrnl.append(("nonce", addr, acct.nonce))
+        acct.nonce = nonce
 
     def validator(self, addr: bytes) -> ValidatorWrapper | None:
         a = self._accounts.get(addr)
@@ -158,21 +172,29 @@ class StateDB:
         return a.code if a else b""
 
     def set_code(self, addr: bytes, code: bytes):
-        self.account(addr).code = code
+        acct = self.account(addr)
+        if self._jrnl is not None:
+            self._jrnl.append(("code", addr, acct.code))
+        acct.code = code
 
     def storage_get(self, addr: bytes, slot: bytes) -> int:
         a = self._accounts.get(addr)
         return a.storage.get(slot, 0) if a else 0
 
     def storage_set(self, addr: bytes, slot: bytes, value: int):
-        st = self.account(addr).storage
+        acct = self.account(addr)
+        if self._jrnl is not None:
+            self._jrnl.append(("slot", addr, slot, acct.storage.get(slot, 0)))
         if value:
-            st[slot] = value
+            acct.storage[slot] = value
         else:
-            st.pop(slot, None)
+            acct.storage.pop(slot, None)
 
     def set_validator(self, wrapper: ValidatorWrapper):
-        self.account(wrapper.address).validator = wrapper
+        acct = self.account(wrapper.address)
+        if self._jrnl is not None:
+            self._jrnl.append(("val", wrapper.address, acct.validator))
+        acct.validator = wrapper
 
     def validator_addresses(self) -> list:
         return sorted(
@@ -185,6 +207,51 @@ class StateDB:
         import copy as _copy
 
         return StateDB(_copy.deepcopy(self._accounts))
+
+    # -- EVM frame journal -------------------------------------------------
+    # Per-call-frame rollback without copying the account map: the EVM
+    # takes snapshot() at frame entry and revert_to() on failure; the
+    # tx driver calls end_tx() once the outermost frame settles.  Only
+    # mutations made through the StateDB methods above are journaled —
+    # in-place edits of a ValidatorWrapper obtained via validator() are
+    # invisible to it (the staking paths use whole-state copies instead;
+    # any EVM-reachable staking mutation must go through set_validator
+    # with a fresh wrapper).
+
+    def snapshot(self) -> int:
+        if self._jrnl is None:
+            self._jrnl = []
+        return len(self._jrnl)
+
+    def revert_to(self, mark: int):
+        j = self._jrnl
+        while j is not None and len(j) > mark:
+            e = j.pop()
+            kind, addr = e[0], e[1]
+            if kind == "new":
+                self._accounts.pop(addr, None)
+                continue
+            acct = self._accounts.get(addr)
+            if acct is None:  # account journal entry preceded by "new"
+                continue
+            if kind == "bal":
+                acct.balance = e[2]
+            elif kind == "nonce":
+                acct.nonce = e[2]
+            elif kind == "code":
+                acct.code = e[2]
+            elif kind == "slot":
+                if e[3]:
+                    acct.storage[e[2]] = e[3]
+                else:
+                    acct.storage.pop(e[2], None)
+            elif kind == "val":
+                acct.validator = e[2]
+
+    def end_tx(self):
+        """Drop the journal once a transaction's outermost frame has
+        settled (its effects are final either way)."""
+        self._jrnl = None
 
     # -- root --------------------------------------------------------------
 

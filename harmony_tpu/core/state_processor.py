@@ -88,6 +88,8 @@ class StateProcessor:
         cx = None
         status = 1
         used = gas
+        logs: list = []
+        created = b""
         if tx.is_cross_shard():
             # cross-shard: value-transfer only (the reference routes no
             # contract execution across shards); data charged, ignored
@@ -113,21 +115,29 @@ class StateProcessor:
 
             state.sub_balance(sender, tx.gas_limit * tx.gas_price)
             env = self._env if self._env is not None else Env(
-                block_num=block_num, chain_id=self.chain_id
+                block_num=block_num, chain_id=self.chain_id,
+                shard_id=self.shard_id,
             )
             evm = EVM(state, env, origin=sender, gas_price=tx.gas_price)
+            if tx.to is not None:
+                evm.warm_addrs.add(tx.to)  # EIP-2929: tx target warm
+            created = b""
             if tx.to is None:
                 # evm.create advances the nonce and derives the address
                 # from the pre-increment value (tx.nonce)
                 ok, gas_left, _addr = evm.create(
                     sender, tx.value, tx.data, tx.gas_limit - gas
                 )
+                if ok:
+                    created = _addr
             else:
                 state.set_nonce(sender, tx.nonce + 1)
                 ok, gas_left, _out = evm.call(
                     sender, tx.to, tx.value, tx.data, tx.gas_limit - gas
                 )
             status = 1 if ok else 0
+            logs = [(lg.address, lg.topics, lg.data) for lg in evm.logs]
+            state.end_tx()  # settle the EVM frame journal
             used = tx.gas_limit - gas_left
             refund = min(evm.refund if ok else 0, used // 2)
             used -= refund
@@ -144,6 +154,8 @@ class StateProcessor:
             status=status,
             gas_used=used,
             cumulative_gas=cumulative_gas + used,
+            logs=logs,
+            contract_address=created,
         )
         return receipt, cx
 
@@ -157,10 +169,11 @@ class StateProcessor:
 
     @staticmethod
     def _is_precompile(addr: bytes | None) -> bool:
-        from .vm import PRECOMPILES
+        from .vm import PRECOMPILES, STAKING_PRECOMPILE_ADDR
 
         return addr is not None and (
             int.from_bytes(addr, "big") in PRECOMPILES
+            or addr == STAKING_PRECOMPILE_ADDR
         )
 
     def apply_incoming_receipt(self, state: StateDB, cx: CXReceipt):
@@ -360,6 +373,7 @@ class StateProcessor:
         self._env = Env(
             block_num=h.block_num, timestamp=h.timestamp,
             chain_id=self.chain_id, epoch=epoch,
+            shard_id=self.shard_id,
         )
         res = ProcessResult()
         for tx, is_staking in block.ordered_txs():

@@ -1,14 +1,21 @@
-"""Mempool: nonce-ordered per sender, price-ordered across senders.
+"""Mempool: executable/queued split, price-ordered, eviction-bounded.
 
-The role of the reference's core/tx_pool.go (1,732 LoC incl. staking
-txs — SURVEY.md §2.4), reduced to the consensus-relevant contract:
+The role of the reference's core/tx_pool.go (SURVEY.md §2.4).  The
+reference's pool discipline, re-implemented:
 
-- ``add`` validates signature, nonce window, balance cover, and gas
-  floor, and replaces same-nonce txs only for a >=10% price bump
-  (the reference's price-bump rule);
-- ``pending`` yields executable txs: per sender a gapless nonce run
-  starting at the state nonce, senders interleaved by gas price;
-- ``drop_applied`` prunes txs at block commit.
+- **pending/queue split** (tx_pool.go's pending vs queue maps): a tx
+  is *executable* when its nonce sits in the gapless run starting at
+  the sender's state nonce; everything above the gap is *queued*.
+  Commits promote queued txs as gaps close (``drop_applied``).
+- **admission** validates signature, shard binding, nonce floor,
+  balance cover at max cost, and the gas-price floor; same-nonce
+  replacement needs a >=10% price bump (PriceBump).
+- **bounded slots** (AccountSlots/AccountQueue/GlobalSlots/
+  GlobalQueue): per-sender and global caps for both tiers; under
+  global pressure the CHEAPEST queued tx is evicted for a
+  better-paying newcomer (underpriced newcomers are rejected).
+- **lifetime eviction**: queued txs older than ``lifetime`` seconds
+  are dropped by ``evict_stale`` (the reference's 3h queue lifetime).
 
 Plain and staking transactions share the pool with a common queue
 discipline (the reference keeps both in one pool as well).
@@ -16,10 +23,15 @@ discipline (the reference keeps both in one pool as well).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass
 
-PRICE_BUMP_PCT = 10
-DEFAULT_POOL_CAP = 8192
+PRICE_BUMP_PCT = 10          # reference: DefaultTxPoolConfig.PriceBump
+ACCOUNT_SLOTS = 16           # executable txs per sender
+ACCOUNT_QUEUE = 64           # queued txs per sender
+GLOBAL_SLOTS = 4096          # executable txs total
+GLOBAL_QUEUE = 1024          # queued txs total
+QUEUE_LIFETIME = 3 * 3600.0  # seconds (reference: 3h)
 
 
 class PoolError(ValueError):
@@ -31,19 +43,53 @@ class _Entry:
     tx: object
     sender: bytes
     is_staking: bool
+    added_at: float
 
 
 class TxPool:
     def __init__(self, chain_id: int, shard_id: int, state_view,
-                 cap: int = DEFAULT_POOL_CAP):
-        """state_view() -> StateDB-like with nonce()/balance()."""
+                 cap: int | None = None, price_floor: int = 1,
+                 lifetime: float = QUEUE_LIFETIME):
+        """state_view() -> StateDB-like with nonce()/balance().
+
+        ``cap``: legacy single-number bound; when given it overrides
+        GLOBAL_SLOTS + GLOBAL_QUEUE combined."""
         self.chain_id = chain_id
         self.shard_id = shard_id
         self._state_view = state_view
-        self.cap = cap
+        self.global_slots = cap if cap is not None else GLOBAL_SLOTS
+        self.global_queue = 0 if cap is not None else GLOBAL_QUEUE
+        self.price_floor = price_floor
+        self.lifetime = lifetime
         # sender -> {nonce -> _Entry}
         self._by_sender: dict[bytes, dict[int, _Entry]] = {}
         self._count = 0
+        self.evicted = 0
+
+    # -- tier classification -------------------------------------------------
+
+    def _split_counts(self, state):
+        """(executable, queued) totals under the current state."""
+        execn = 0
+        for sender, slots in self._by_sender.items():
+            nonce = state.nonce(sender)
+            while nonce in slots:
+                execn += 1
+                nonce += 1
+        return execn, self._count - execn
+
+    def stats(self):
+        """(pending, queued) — the reference's Stats()."""
+        return self._split_counts(self._state_view())
+
+    def _sender_exec_count(self, state, sender) -> int:
+        slots = self._by_sender.get(sender, {})
+        nonce = state.nonce(sender)
+        n = 0
+        while nonce in slots:
+            n += 1
+            nonce += 1
+        return n
 
     # -- admission ---------------------------------------------------------
 
@@ -57,7 +103,7 @@ class TxPool:
         state = self._state_view()
         if tx.nonce < state.nonce(sender):
             raise PoolError("nonce too low")
-        if tx.gas_price < 1:
+        if tx.gas_price < self.price_floor:
             raise PoolError("gas price below floor")
         if is_staking:
             # delegated/self-staked amount must be covered up front
@@ -69,20 +115,55 @@ class TxPool:
             raise PoolError("insufficient balance for max cost")
         return sender
 
+    def _evict_cheapest_queued(self, state, min_price: int) -> bool:
+        """Drop the lowest-priced NON-executable tx if it pays less
+        than ``min_price`` (the reference's pricedList eviction)."""
+        worst = None  # (price, sender, nonce)
+        for sender, slots in self._by_sender.items():
+            exec_top = state.nonce(sender)
+            while exec_top in slots:
+                exec_top += 1
+            for nonce, e in slots.items():
+                if nonce >= exec_top and (
+                    worst is None or e.tx.gas_price < worst[0]
+                ):
+                    worst = (e.tx.gas_price, sender, nonce)
+        if worst is None or worst[0] >= min_price:
+            return False
+        del self._by_sender[worst[1]][worst[2]]
+        if not self._by_sender[worst[1]]:
+            del self._by_sender[worst[1]]
+        self._count -= 1
+        self.evicted += 1
+        return True
+
     def add(self, tx, is_staking: bool = False) -> bytes:
         """Admit a tx; returns the recovered sender. Raises PoolError."""
         sender = self._validate(tx, is_staking)
+        state = self._state_view()
         slots = self._by_sender.setdefault(sender, {})
         old = slots.get(tx.nonce)
         if old is not None:
             bump = old.tx.gas_price * (100 + PRICE_BUMP_PCT) // 100
             if tx.gas_price < max(bump, old.tx.gas_price + 1):
                 raise PoolError("replacement underpriced")
-            slots[tx.nonce] = _Entry(tx, sender, is_staking)
+            slots[tx.nonce] = _Entry(tx, sender, is_staking,
+                                     time.monotonic())
             return sender
-        if self._count >= self.cap:
-            raise PoolError("pool full")
-        slots[tx.nonce] = _Entry(tx, sender, is_staking)
+        # per-sender caps: executable run vs queued tail
+        exec_n = self._sender_exec_count(state, sender)
+        sender_total = len(slots)
+        executable = tx.nonce <= state.nonce(sender) + exec_n
+        if executable and exec_n >= ACCOUNT_SLOTS:
+            raise PoolError("sender executable slots full")
+        if not executable and (sender_total - exec_n) >= ACCOUNT_QUEUE:
+            raise PoolError("sender queue full")
+        # global pressure: try evicting a cheaper queued tx first
+        limit = self.global_slots + self.global_queue
+        if self._count >= limit:
+            if not self._evict_cheapest_queued(state, tx.gas_price):
+                raise PoolError("pool full (newcomer underpriced)")
+        slots[tx.nonce] = _Entry(tx, sender, is_staking, time.monotonic())
         self._count += 1
         return sender
 
@@ -119,11 +200,26 @@ class TxPool:
                 break
         return out
 
+    def queued(self):
+        """Non-executable (tx, is_staking) pairs (future-nonce tail)."""
+        state = self._state_view()
+        out = []
+        for sender, slots in self._by_sender.items():
+            exec_top = state.nonce(sender)
+            while exec_top in slots:
+                exec_top += 1
+            for nonce in sorted(slots):
+                if nonce >= exec_top:
+                    e = slots[nonce]
+                    out.append((e.tx, e.is_staking))
+        return out
+
     # -- maintenance -------------------------------------------------------
 
     def drop_applied(self):
         """Prune txs whose nonce is now below the state nonce (called
-        after a block commits)."""
+        after a block commits); queued txs just above the new nonce
+        become executable implicitly (promotion is the tier REREAD)."""
         state = self._state_view()
         for sender in list(self._by_sender):
             slots = self._by_sender[sender]
@@ -131,6 +227,26 @@ class TxPool:
             for nonce in [n for n in slots if n < floor]:
                 del slots[nonce]
                 self._count -= 1
+            if not slots:
+                del self._by_sender[sender]
+
+    def evict_stale(self, now: float | None = None):
+        """Drop queued txs older than the lifetime (reference: the 3h
+        queue eviction loop)."""
+        now = time.monotonic() if now is None else now
+        state = self._state_view()
+        for sender in list(self._by_sender):
+            slots = self._by_sender[sender]
+            exec_top = state.nonce(sender)
+            while exec_top in slots:
+                exec_top += 1
+            for nonce in [
+                n for n, e in slots.items()
+                if n >= exec_top and now - e.added_at > self.lifetime
+            ]:
+                del slots[nonce]
+                self._count -= 1
+                self.evicted += 1
             if not slots:
                 del self._by_sender[sender]
 

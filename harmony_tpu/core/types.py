@@ -189,6 +189,40 @@ class Receipt:
     status: int  # 1 ok, 0 failed
     gas_used: int
     cumulative_gas: int
+    # EVM event logs: [(address20, [topic32...], data)] — consumed by
+    # eth_getLogs / filters (reference: core/types/log.go)
+    logs: list = field(default_factory=list)
+    contract_address: bytes = b""  # set for successful deployments
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += _enc_bytes(self.tx_hash)
+        out += _enc_int(self.status, 1)
+        out += _enc_int(self.gas_used) + _enc_int(self.cumulative_gas)
+        out += _enc_bytes(self.contract_address)
+        out += _enc_int(len(self.logs), 4)
+        for addr, topics, data in self.logs:
+            out += _enc_bytes(addr)
+            out += _enc_int(len(topics), 2)
+            for t in topics:
+                out += _enc_bytes(t)
+            out += _enc_bytes(data)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, r: "Reader") -> "Receipt":
+        tx_hash = r.bytes_()
+        status = r.int_(1)
+        gas_used = r.int_()
+        cumulative = r.int_()
+        contract = r.bytes_()
+        logs = []
+        for _ in range(r.int_(4)):
+            addr = r.bytes_()
+            topics = [r.bytes_() for _ in range(r.int_(2))]
+            logs.append((addr, topics, r.bytes_()))
+        return cls(tx_hash, status, gas_used, cumulative,
+                   logs=logs, contract_address=contract)
 
 
 @dataclass

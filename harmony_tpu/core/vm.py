@@ -6,18 +6,27 @@ Design: a host-side bytecode interpreter over the flat StateDB (EVM
 execution is branchy, serial, and consensus-critical — per SURVEY §7.2
 it stays off the accelerator; the TPU owns the crypto lattice, not the
 contract ISA).  Word ops are Python ints masked to 256 bits; state
-mutation goes through a journaling frame so REVERT/failure unwinds
-exactly (reference: core/vm/interpreter.go Run + StateDB snapshots).
+mutation is recorded in the StateDB undo journal so REVERT/failure
+unwinds in O(touched entries), not O(state size) (reference:
+core/vm/interpreter.go Run + StateDB journaled snapshots).
 
 Gas: Istanbul-shaped constant table + quadratic memory expansion +
-simplified SSTORE metering (set 20k / update 5k / clear refund 15k).
-Documented deviations from the reference's exact EIP-2200/2929 warm/
-cold accounting: no access-list warmth tracking (every touch priced
-warm); refunds capped at gas_used // 2.
+EIP-2929 warm/cold access lists (behind the ``berlin`` switch, on by
+default: 2600/2100 cold account/slot, 100 warm, access lists reverted
+with their frame) + simplified SSTORE metering (set 20k / update 5k /
+clear refund 15k, plus the 2929 cold surcharge).  Documented deviation
+from the reference's exact EIP-2200 net metering: refunds capped at
+gas_used // 2.
 
 Precompiles 0x1-0x5, 0x9-shape: ecrecover, sha256, ripemd160,
 identity, modexp (bn256 pairing precompiles return failure — no BN254
-lattice here; the BLS12-381 ops own the pairing budget).
+lattice here; the BLS12-381 ops own the pairing budget).  Address 252
+is the Harmony staking precompile (write-capable: Delegate/Undelegate/
+CollectRewards from contract code, beacon shard only — reference:
+staking/precompile.go, core/vm/contracts_write.go).
+
+Tracing: pass ``tracer=CallTracer()`` to capture the nested call tree
+(debug_traceTransaction callTracer shape).
 """
 
 from __future__ import annotations
@@ -75,13 +84,14 @@ class Env:
 
     def __init__(self, block_num=0, timestamp=0, coinbase=b"\x00" * 20,
                  gas_limit=30_000_000, chain_id=1, epoch=0,
-                 block_hash_fn=None):
+                 block_hash_fn=None, shard_id=0):
         self.block_num = block_num
         self.timestamp = timestamp
         self.coinbase = coinbase
         self.gas_limit = gas_limit
         self.chain_id = chain_id
         self.epoch = epoch
+        self.shard_id = shard_id
         self.block_hash_fn = block_hash_fn or (lambda n: bytes(32))
 
 
@@ -165,6 +175,8 @@ class Frame:
         return self.stack.pop()
 
     def mem_gas(self, offset: int, size: int):
+        if size == 0:
+            return  # zero-size ops are free no-ops at any offset
         if offset + size > 2 ** 32:
             raise VMError("memory offset too large")
         self.use_gas(self.mem.expansion_cost(offset, size))
@@ -279,10 +291,95 @@ PRECOMPILES = {
 }
 
 
+# ----------------------------------------------------------------------
+# Harmony staking precompile (write-capable, address 252 — reference:
+# staking/precompile.go ParseStakeMsg + core/vm/contracts_write.go
+# stakingPrecompile; beacon shard only)
+# ----------------------------------------------------------------------
+
+STAKING_PRECOMPILE_ADDR = (252).to_bytes(20, "big")
+
+_SEL_DELEGATE = keccak256(b"Delegate(address,address,uint256)")[:4]
+_SEL_UNDELEGATE = keccak256(b"Undelegate(address,address,uint256)")[:4]
+_SEL_COLLECT = keccak256(b"CollectRewards(address)")[:4]
+
+
+def _abi_addr(word: bytes) -> bytes:
+    if any(word[:12]):
+        raise VMError("malformed ABI address (dirty upper bytes)")
+    return word[12:32]
+
+
+def parse_stake_msg(caller: bytes, data: bytes):
+    """Decode the three supported staking methods.  The delegator
+    argument MUST equal the calling contract — a contract may only
+    stake its own balance (reference: staking/precompile.go:125-131
+    ValidateContractAddress)."""
+    if len(data) < 4:
+        raise VMError("staking precompile: short input")
+    sel, body = data[:4], data[4:]
+    if sel == _SEL_COLLECT:
+        if len(body) != 32:
+            raise VMError("staking precompile: bad CollectRewards args")
+        delegator = _abi_addr(body[:32])
+        if delegator != caller:
+            raise VMError("delegator is not the caller")
+        return ("collect", delegator, None, 0)
+    if sel in (_SEL_DELEGATE, _SEL_UNDELEGATE):
+        if len(body) != 96:
+            raise VMError("staking precompile: bad (un)delegate args")
+        delegator = _abi_addr(body[:32])
+        validator = _abi_addr(body[32:64])
+        amount = int.from_bytes(body[64:96], "big")
+        if delegator != caller:
+            raise VMError("delegator is not the caller")
+        kind = "delegate" if sel == _SEL_DELEGATE else "undelegate"
+        return (kind, delegator, validator, amount)
+    raise VMError("staking precompile: unknown selector")
+
+
+# EIP-2929 access costs (reference: core/vm adopted warm/cold gas;
+# applied here behind the ``berlin`` switch)
+COLD_ACCOUNT_ACCESS = 2600
+COLD_SLOAD = 2100
+WARM_ACCESS = 100
+
+
+class CallTracer:
+    """Minimal callTracer-shaped tracer: a nested dict of frames
+    (reference: the debug_traceTransaction callTracer of eth/tracers,
+    surfaced via rpc).  Attach via EVM(tracer=...); read ``.root``."""
+
+    def __init__(self):
+        self.root = None
+        self._stack: list[dict] = []
+
+    def enter(self, typ: str, frm: bytes, to: bytes, value: int,
+              gas: int, data: bytes):
+        node = {
+            "type": typ, "from": frm.hex(), "to": to.hex(),
+            "value": hex(value), "gas": gas, "input": data.hex(),
+            "calls": [],
+        }
+        if self._stack:
+            self._stack[-1]["calls"].append(node)
+        else:
+            self.root = node
+        self._stack.append(node)
+
+    def exit(self, ok: bool, gas_left: int, output: bytes):
+        node = self._stack.pop()
+        node["gasUsed"] = node["gas"] - gas_left
+        node["output"] = output.hex()
+        if not ok:
+            node["error"] = "execution reverted"
+
+
 class EVM:
     """The interpreter.  One instance per transaction."""
 
-    def __init__(self, state, env: Env, origin: bytes, gas_price: int):
+    def __init__(self, state, env: Env, origin: bytes, gas_price: int,
+                 berlin: bool = True, tracer: CallTracer | None = None):
         self.state = state
         self.env = env
         self.origin = origin
@@ -290,6 +387,29 @@ class EVM:
         self.logs: list[Log] = []
         self.refund = 0
         self.depth = 0
+        self.berlin = berlin
+        self.tracer = tracer
+        self.stake_msgs: list = []  # applied staking-precompile ops
+        # EIP-2929 access lists: origin + precompiles warm at tx start
+        self.warm_addrs: set = {origin} | {
+            a.to_bytes(20, "big") for a in PRECOMPILES
+        } | {STAKING_PRECOMPILE_ADDR}
+        self.warm_slots: set = set()
+
+    # -- EIP-2929 access accounting ----------------------------------------
+
+    def _addr_access_gas(self, addr: bytes) -> int:
+        if addr in self.warm_addrs:
+            return WARM_ACCESS
+        self.warm_addrs.add(addr)
+        return COLD_ACCOUNT_ACCESS
+
+    def _slot_access_gas(self, addr: bytes, slot: bytes) -> int:
+        key = (addr, slot)
+        if key in self.warm_slots:
+            return WARM_ACCESS
+        self.warm_slots.add(key)
+        return COLD_SLOAD
 
     # -- entry points ------------------------------------------------------
 
@@ -298,8 +418,36 @@ class EVM:
         """Message call; returns (ok, gas_left, output)."""
         if self.depth >= MAX_DEPTH:
             return False, gas, b""
+        if to == STAKING_PRECOMPILE_ADDR:
+            if static:
+                return False, 0, b""  # write-capable: no static calls
+            snap = self._snapshot()
+            if self.tracer:
+                self.tracer.enter("CALL", caller, to, value, gas, data)
+            # ordinary CALL value semantics apply (the transfer lands
+            # on the precompile address and unwinds with the frame)
+            if value:
+                if self.state.balance(caller) < value:
+                    if self.tracer:
+                        self.tracer.exit(False, gas, b"")
+                    return False, gas, b""
+                self.state.sub_balance(caller, value)
+                self.state.add_balance(to, value)
+            try:
+                gas_left, out = self._run_staking_precompile(
+                    caller, data, gas
+                )
+                if self.tracer:
+                    self.tracer.exit(True, gas_left, out)
+                return True, gas_left, out
+            except VMError:
+                self._restore(snap)
+                if self.tracer:
+                    self.tracer.exit(False, 0, b"")
+                return False, 0, b""
         fn = PRECOMPILES.get(_addr_word(to))
         if fn is not None:
+            snap = self._snapshot()
             if value and not static:
                 if self.state.balance(caller) < value:
                     return False, gas, b""
@@ -309,27 +457,45 @@ class EVM:
                 gas_left, out = fn(data, gas)
                 return True, gas_left, out
             except VMError:
+                # a failed call has NO state effect — unwind the value
+                # transfer too
+                self._restore(snap)
                 return False, 0, b""
         snap = self._snapshot()
+        if self.tracer:
+            self.tracer.enter(
+                "STATICCALL" if static else "CALL",
+                caller, to, value, gas, data,
+            )
         if value and not static:
             if self.state.balance(caller) < value:
+                if self.tracer:
+                    self.tracer.exit(False, gas, b"")
                 return False, gas, b""
             self.state.sub_balance(caller, value)
             self.state.add_balance(to, value)
         code = self.state.code(to)
         if not code:
+            if self.tracer:
+                self.tracer.exit(True, gas, b"")
             return True, gas, b""
         self.depth += 1
         try:
             out, gas_left = self._run(
                 code, caller, to, value, data, gas, static
             )
+            if self.tracer:
+                self.tracer.exit(True, gas_left, out)
             return True, gas_left, out
         except Revert as r:
             self._restore(snap)
+            if self.tracer:
+                self.tracer.exit(False, r.gas_left, r.data)
             return False, r.gas_left, r.data
         except VMError:
             self._restore(snap)
+            if self.tracer:
+                self.tracer.exit(False, 0, b"")
             return False, 0, b""
         finally:
             self.depth -= 1
@@ -350,6 +516,11 @@ class EVM:
         if self.state.code(addr) or self.state.nonce(addr):
             return False, 0, b""  # address collision
         snap = self._snapshot()
+        if self.tracer:
+            self.tracer.enter(
+                "CREATE2" if salt is not None else "CREATE",
+                caller, addr, value, gas, init_code,
+            )
         self.state.sub_balance(caller, value)
         self.state.add_balance(addr, value)
         self.state.set_nonce(addr, 1)
@@ -364,26 +535,119 @@ class EVM:
             if gas_left < deposit:
                 raise VMError("code deposit oog")
             self.state.set_code(addr, code)
+            if self.tracer:
+                self.tracer.exit(True, gas_left - deposit, code)
             return True, gas_left - deposit, addr
         except Revert as r:
             self._restore(snap)
+            if self.tracer:
+                self.tracer.exit(False, r.gas_left, r.data)
             return False, r.gas_left, b""
         except VMError:
             self._restore(snap)
+            if self.tracer:
+                self.tracer.exit(False, 0, b"")
             return False, 0, b""
         finally:
             self.depth -= 1
 
+    # -- staking precompile (write-capable, beacon shard only) -------------
+
+    def _run_staking_precompile(self, caller: bytes, data: bytes,
+                                gas: int):
+        """Delegate/Undelegate/CollectRewards from contract code
+        (reference: core/vm/contracts_write.go RunWriteCapable).  All
+        mutations go through journaled StateDB methods — wrappers are
+        deep-copied and written back via set_validator so an outer
+        REVERT unwinds the staking op too."""
+        import copy as _copy
+
+        if self.env.shard_id != 0:
+            raise VMError("staking not supported on this shard")
+        kind, delegator, validator, amount = parse_stake_msg(caller, data)
+        # intrinsic-shaped charge (reference meters IntrinsicGas of the
+        # RLP-encoded msg): base tx gas + Istanbul calldata pricing
+        cost = 21000 + sum(16 if b else 4 for b in data)
+        if gas < cost:
+            raise VMError("staking precompile oog")
+        gas -= cost
+        st = self.state
+        if kind == "delegate":
+            w = st.validator(validator)
+            if w is None:
+                raise VMError("no such validator")
+            if amount <= 0 or st.balance(delegator) < amount:
+                raise VMError("bad delegation amount")
+            w = _copy.deepcopy(w)
+            if w.max_total_delegation and (
+                w.total_delegation() + amount > w.max_total_delegation
+            ):
+                raise VMError("exceeds max total delegation")
+            st.sub_balance(delegator, amount)
+            for d in w.delegations:
+                if d.delegator == delegator:
+                    d.amount += amount
+                    break
+            else:
+                from .state import Delegation
+
+                w.delegations.append(Delegation(delegator, amount))
+            st.set_validator(w)
+            self.stake_msgs.append((kind, delegator, validator, amount))
+        elif kind == "undelegate":
+            w = st.validator(validator)
+            if w is None:
+                raise VMError("no such validator")
+            if amount <= 0:
+                raise VMError("bad undelegation amount")
+            w = _copy.deepcopy(w)
+            for d in w.delegations:
+                if d.delegator == delegator:
+                    if d.amount < amount:
+                        raise VMError("undelegate exceeds delegation")
+                    d.amount -= amount
+                    d.undelegations.append((amount, self.env.epoch))
+                    break
+            else:
+                raise VMError("no delegation to undelegate")
+            st.set_validator(w)
+            self.stake_msgs.append((kind, delegator, validator, amount))
+        else:  # collect
+            total = 0
+            for addr in st.validator_addresses():
+                w = st.validator(addr)
+                if not any(
+                    d.delegator == delegator and d.reward
+                    for d in w.delegations
+                ):
+                    continue
+                w = _copy.deepcopy(w)
+                for d in w.delegations:
+                    if d.delegator == delegator and d.reward:
+                        total += d.reward
+                        d.reward = 0
+                st.set_validator(w)
+            if total == 0:
+                raise VMError("no rewards to collect")
+            st.add_balance(delegator, total)
+            self.stake_msgs.append((kind, delegator, None, total))
+        return gas, b""
+
     # -- state snapshots ---------------------------------------------------
 
     def _snapshot(self):
-        return (self.state.copy(), len(self.logs), self.refund)
+        # warm sets are COPIED: EIP-2929 rolls access lists back when a
+        # frame reverts
+        return (self.state.snapshot(), len(self.logs), self.refund,
+                set(self.warm_addrs), set(self.warm_slots))
 
     def _restore(self, snap):
-        state_copy, n_logs, refund = snap
-        self.state._accounts = state_copy._accounts
+        mark, n_logs, refund, warm_a, warm_s = snap
+        self.state.revert_to(mark)
         del self.logs[n_logs:]
         self.refund = refund
+        self.warm_addrs = warm_a
+        self.warm_slots = warm_s
 
     # -- the dispatch loop -------------------------------------------------
 
@@ -489,8 +753,11 @@ class EVM:
             elif op == 0x30:  # ADDRESS
                 f.use_gas(2); f.push(_addr_word(address))
             elif op == 0x31:  # BALANCE
-                f.use_gas(BALANCE_GAS)
-                f.push(self.state.balance(_word_addr(f.pop())))
+                a = _word_addr(f.pop())
+                f.use_gas(
+                    self._addr_access_gas(a) if self.berlin else BALANCE_GAS
+                )
+                f.push(self.state.balance(a))
             elif op == 0x32:  # ORIGIN
                 f.use_gas(2); f.push(_addr_word(self.origin))
             elif op == 0x33:  # CALLER
@@ -519,12 +786,17 @@ class EVM:
             elif op == 0x3A:  # GASPRICE
                 f.use_gas(2); f.push(self.gas_price)
             elif op == 0x3B:  # EXTCODESIZE
-                f.use_gas(EXTCODE_GAS)
-                f.push(len(self.state.code(_word_addr(f.pop()))))
+                a = _word_addr(f.pop())
+                f.use_gas(
+                    self._addr_access_gas(a) if self.berlin else EXTCODE_GAS
+                )
+                f.push(len(self.state.code(a)))
             elif op == 0x3C:  # EXTCODECOPY
                 addr2 = _word_addr(f.pop())
                 dst = f.pop(); src = f.pop(); size = f.pop()
-                f.use_gas(EXTCODE_GAS + COPY_WORD_GAS * _mem_words(size))
+                base = (self._addr_access_gas(addr2) if self.berlin
+                        else EXTCODE_GAS)
+                f.use_gas(base + COPY_WORD_GAS * _mem_words(size))
                 f.mem_gas(dst, size)
                 ext = self.state.code(addr2)
                 mem.write(dst, ext[src:src + size].ljust(size, b"\x00"))
@@ -538,8 +810,10 @@ class EVM:
                 f.mem_gas(dst, size)
                 mem.write(dst, f.returndata[src:src + size])
             elif op == 0x3F:  # EXTCODEHASH
-                f.use_gas(EXTCODE_GAS)
                 a = _word_addr(f.pop())
+                f.use_gas(
+                    self._addr_access_gas(a) if self.berlin else EXTCODE_GAS
+                )
                 c = self.state.code(a)
                 if not c and not self.state.balance(a) and not self.state.nonce(a):
                     f.push(0)
@@ -579,17 +853,26 @@ class EVM:
                 f.mem_gas(off, 1)
                 mem.write(off, bytes([v & 0xFF]))
             elif op == 0x54:  # SLOAD
-                f.use_gas(SLOAD_GAS)
                 slot = f.pop().to_bytes(32, "big")
+                f.use_gas(
+                    self._slot_access_gas(address, slot) if self.berlin
+                    else SLOAD_GAS
+                )
                 f.push(self.state.storage_get(address, slot))
             elif op == 0x55:  # SSTORE
                 if static:
                     raise VMError("SSTORE in static context")
                 slot = f.pop().to_bytes(32, "big")
                 v = f.pop()
+                if self.berlin:
+                    # EIP-2929: cold-slot surcharge on top of the
+                    # simplified set/update metering
+                    if (address, slot) not in self.warm_slots:
+                        self.warm_slots.add((address, slot))
+                        f.use_gas(COLD_SLOAD)
                 cur = self.state.storage_get(address, slot)
                 if cur == v:
-                    f.use_gas(SLOAD_GAS)
+                    f.use_gas(WARM_ACCESS if self.berlin else SLOAD_GAS)
                 elif cur == 0:
                     f.use_gas(SSTORE_SET)
                 else:
@@ -656,7 +939,9 @@ class EVM:
                 out_off = f.pop(); out_size = f.pop()
                 if static and op == 0xF1 and val:
                     raise VMError("value call in static context")
-                f.use_gas(CALL_GAS)
+                f.use_gas(
+                    self._addr_access_gas(to) if self.berlin else CALL_GAS
+                )
                 if val:
                     f.use_gas(CALL_VALUE_GAS)
                     if op == 0xF1 and not (
@@ -730,6 +1015,15 @@ class EVM:
         storage_addr's context."""
         if self.depth >= MAX_DEPTH:
             return False, gas, b""
+        fn = PRECOMPILES.get(_addr_word(code_addr))
+        if fn is not None:
+            # precompiles are reachable through every call type; there
+            # is no value transfer on this path so no snapshot needed
+            try:
+                gas_left, out = fn(data, gas)
+                return True, gas_left, out
+            except VMError:
+                return False, 0, b""
         snap = self._snapshot()
         code = self.state.code(code_addr)
         if not code:

@@ -372,3 +372,76 @@ def test_rawdb_codecs_roundtrip():
     stx2 = rawdb.decode_staking_tx(rawdb.encode_staking_tx(stx, CHAIN_ID))
     assert stx2.hash(CHAIN_ID) == stx.hash(CHAIN_ID)
     assert stx2.fields == stx.fields
+
+
+def test_pool_pending_queue_split_and_stats():
+    key = E.ECDSAKey.from_seed(b"tier")
+    state = StateDB()
+    state.add_balance(key.address(), 10**9)
+    pool = TxPool(CHAIN_ID, 0, lambda: state)
+    to = b"\x08" * 20
+    pool.add(_transfer(key, 0, to, 1))
+    pool.add(_transfer(key, 1, to, 1))
+    pool.add(_transfer(key, 5, to, 1))  # gapped: queued
+    pending, queued = pool.stats()
+    assert (pending, queued) == (2, 1)
+    assert [t.nonce for t, _ in pool.queued()] == [5]
+    # closing the gap promotes the queued tx
+    pool.add(_transfer(key, 2, to, 1))
+    pool.add(_transfer(key, 3, to, 1))
+    pool.add(_transfer(key, 4, to, 1))
+    pending, queued = pool.stats()
+    assert (pending, queued) == (6, 0)
+
+
+def test_pool_global_pressure_evicts_cheapest_queued():
+    keys = [E.ECDSAKey.from_seed(bytes([i])) for i in range(3)]
+    state = StateDB()
+    for k in keys:
+        state.add_balance(k.address(), 10**12)
+    pool = TxPool(CHAIN_ID, 0, lambda: state, cap=3)
+    to = b"\x08" * 20
+    pool.add(_transfer(keys[0], 0, to, 1, gas_price=5))
+    pool.add(_transfer(keys[1], 7, to, 1, gas_price=2))   # queued, cheap
+    pool.add(_transfer(keys[2], 9, to, 1, gas_price=8))   # queued, rich
+    assert len(pool) == 3
+    # an underpriced newcomer cannot displace anything
+    with pytest.raises(PoolError):
+        pool.add(_transfer(keys[0], 1, to, 1, gas_price=1))
+    # a better-paying one evicts the cheapest QUEUED tx (key1 nonce 7)
+    pool.add(_transfer(keys[0], 1, to, 1, gas_price=6))
+    assert len(pool) == 3
+    assert pool.evicted == 1
+    assert all(
+        t.sender(CHAIN_ID) != keys[1].address() for t, _ in pool.queued()
+    )
+
+
+def test_pool_account_slot_caps():
+    from harmony_tpu.core.tx_pool import ACCOUNT_QUEUE
+
+    key = E.ECDSAKey.from_seed(b"caps")
+    state = StateDB()
+    state.add_balance(key.address(), 10**15)
+    pool = TxPool(CHAIN_ID, 0, lambda: state)
+    to = b"\x08" * 20
+    # fill the queued tier for one sender (nonces far above state)
+    for i in range(ACCOUNT_QUEUE):
+        pool.add(_transfer(key, 1000 + i, to, 1))
+    with pytest.raises(PoolError):
+        pool.add(_transfer(key, 5000, to, 1))
+
+
+def test_pool_lifetime_eviction():
+    key = E.ECDSAKey.from_seed(b"stale")
+    state = StateDB()
+    state.add_balance(key.address(), 10**9)
+    pool = TxPool(CHAIN_ID, 0, lambda: state, lifetime=10.0)
+    to = b"\x08" * 20
+    pool.add(_transfer(key, 0, to, 1))   # executable: survives
+    pool.add(_transfer(key, 9, to, 1))   # queued: expires
+    import time as _t
+
+    pool.evict_stale(now=_t.monotonic() + 11.0)
+    assert len(pool) == 1
+    assert pool.stats() == (1, 0)

@@ -180,3 +180,295 @@ def test_processor_contract_path():
         proc2.apply_transaction(state2, tx, i + 1, 0)
     assert state2.root() == state.root()
     assert state2.mpt_root() == state.mpt_root()
+
+
+def test_failed_precompile_call_reverts_value_transfer():
+    """A precompile call that runs out of gas must leave NO state effect
+    (advisor r2: the value transfer used to survive the failure)."""
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    sha = (2).to_bytes(20, "big")
+    # gas 10 is below the sha256 base cost of 60 -> precompile fails
+    ok, gas_left, out = evm.call(A, sha, 777, b"x", 10)
+    assert not ok
+    assert state.balance(A) == 10**18
+    assert state.balance(sha) == 0
+
+
+def test_zero_size_memory_op_with_huge_offset_is_free():
+    """RETURN(huge_offset, 0) must not fail the offset bound check
+    (advisor r2: zero-size ops are free no-ops in the EVM)."""
+    state = StateDB()
+    evm = _evm(state)
+    # PUSH1 0; PUSH8 2^60; RETURN  -> return(huge, 0)
+    code = bytes([0x60, 0x00, 0x67]) + (1 << 60).to_bytes(8, "big") + bytes([0xF3])
+    out, gas = evm._run(code, A, A, 0, b"", 100_000, False)
+    assert out == b""
+
+
+def test_delegatecall_reaches_precompile():
+    """DELEGATECALL to sha256 must execute the precompile, not succeed
+    with empty output (advisor r2)."""
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    # contract: calldatacopy(0,0,calldatasize);
+    #   delegatecall(gas, 0x2, 0, calldatasize, 0x20, 0x20); pop
+    #   return(0x20, 0x20)
+    code = bytes([
+        0x36, 0x60, 0x00, 0x60, 0x00, 0x37,        # calldatacopy(0,0,size)
+        0x60, 0x20, 0x60, 0x20, 0x36, 0x60, 0x00,  # out 0x20/0x20, in 0/size
+        0x60, 0x02, 0x5A, 0xF4,                    # delegatecall(gas, 2, ...)
+        0x50,                                      # pop ok flag
+        0x60, 0x20, 0x60, 0x20, 0xF3,              # return(0x20, 0x20)
+    ])
+    import hashlib
+    ca = b"\xcc" * 20
+    state.set_code(ca, code)
+    ok, _, out = evm.call(A, ca, 0, b"abc", 500_000)
+    assert ok
+    assert out == hashlib.sha256(b"abc").digest()
+
+
+def test_journal_nested_revert_restores_exact_state():
+    """Nested CALL reverting must roll back only the inner frame's
+    mutations (journal replaces full-state deepcopy; advisor r2)."""
+    state = StateDB()
+    state.add_balance(A, 10**18)
+    evm = _evm(state)
+    # inner contract: sstore(0, 7); revert(0,0)
+    inner = bytes([0x60, 0x07, 0x60, 0x00, 0x55, 0x60, 0x00, 0x60, 0x00, 0xFD])
+    ia = b"\xdd" * 20
+    state.set_code(ia, inner)
+    # outer: sstore(0, 5); call(gas, inner, 0, 0,0,0,0); sstore(1, 9); stop
+    outer = bytes([
+        0x60, 0x05, 0x60, 0x00, 0x55,              # sstore(0, 5)
+        0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+        0x60, 0x00, 0x73]) + ia + bytes([          # push addr
+        0x5A, 0xF1, 0x50,                          # call, pop
+        0x60, 0x09, 0x60, 0x01, 0x55,              # sstore(1, 9)
+        0x00,
+    ])
+    oa = b"\xee" * 20
+    state.set_code(oa, outer)
+    ok, _, _ = evm.call(A, oa, 0, b"", 500_000)
+    assert ok
+    assert state.storage_get(oa, b"\x00" * 32) == 5     # outer write kept
+    assert state.storage_get(oa, (1).to_bytes(32, "big")) == 9
+    assert state.storage_get(ia, b"\x00" * 32) == 0     # inner write undone
+    state.end_tx()
+
+
+def test_journal_end_tx_disables_journaling():
+    state = StateDB()
+    mark = state.snapshot()
+    state.add_balance(A, 5)
+    state.end_tx()
+    state.add_balance(A, 5)       # not journaled
+    assert state.balance(A) == 10
+
+
+# -- staking precompile (address 252), EIP-2929, call tracer ------------
+
+
+def _mk_validator(state, vaddr):
+    from harmony_tpu.core.state import Delegation, ValidatorWrapper
+
+    state.set_validator(ValidatorWrapper(
+        address=vaddr, bls_keys=[b"\x01" * 48],
+        delegations=[Delegation(vaddr, 100)],
+    ))
+
+
+def _stake_calldata(selector_sig, *args32):
+    sel = keccak256(selector_sig)[:4]
+    return sel + b"".join(args32)
+
+
+def test_staking_precompile_delegate_from_contract():
+    from harmony_tpu.core.vm import STAKING_PRECOMPILE_ADDR
+
+    state = StateDB()
+    vaddr = b"\x56" * 20
+    _mk_validator(state, vaddr)
+    ca = b"\xcb" * 20  # the delegating contract
+    state.add_balance(ca, 10_000)
+    evm = _evm(state)
+    data = _stake_calldata(
+        b"Delegate(address,address,uint256)",
+        ca.rjust(32, b"\x00"), vaddr.rjust(32, b"\x00"),
+        (500).to_bytes(32, "big"),
+    )
+    ok, gas_left, out = evm.call(ca, STAKING_PRECOMPILE_ADDR, 0, data,
+                                 200_000)
+    assert ok
+    assert state.balance(ca) == 9_500
+    w = state.validator(vaddr)
+    assert any(d.delegator == ca and d.amount == 500
+               for d in w.delegations)
+    assert evm.stake_msgs == [("delegate", ca, vaddr, 500)]
+
+
+def test_staking_precompile_rejects_other_delegator():
+    from harmony_tpu.core.vm import STAKING_PRECOMPILE_ADDR
+
+    state = StateDB()
+    vaddr = b"\x56" * 20
+    _mk_validator(state, vaddr)
+    ca = b"\xcb" * 20
+    other = b"\xcc" * 20
+    state.add_balance(ca, 10_000)
+    evm = _evm(state)
+    data = _stake_calldata(
+        b"Delegate(address,address,uint256)",
+        other.rjust(32, b"\x00"), vaddr.rjust(32, b"\x00"),
+        (500).to_bytes(32, "big"),
+    )
+    ok, _, _ = evm.call(ca, STAKING_PRECOMPILE_ADDR, 0, data, 200_000)
+    assert not ok
+    assert state.balance(ca) == 10_000  # nothing moved
+
+
+def test_staking_precompile_undelegate_and_collect():
+    from harmony_tpu.core.state import Delegation, ValidatorWrapper
+    from harmony_tpu.core.vm import STAKING_PRECOMPILE_ADDR
+
+    state = StateDB()
+    vaddr = b"\x56" * 20
+    ca = b"\xcb" * 20
+    state.set_validator(ValidatorWrapper(
+        address=vaddr, bls_keys=[b"\x01" * 48],
+        delegations=[Delegation(vaddr, 100),
+                     Delegation(ca, 300, reward=44)],
+    ))
+    evm = _evm(state)
+    data = _stake_calldata(
+        b"Undelegate(address,address,uint256)",
+        ca.rjust(32, b"\x00"), vaddr.rjust(32, b"\x00"),
+        (200).to_bytes(32, "big"),
+    )
+    ok, _, _ = evm.call(ca, STAKING_PRECOMPILE_ADDR, 0, data, 200_000)
+    assert ok
+    w = state.validator(vaddr)
+    d = next(d for d in w.delegations if d.delegator == ca)
+    assert d.amount == 100 and d.undelegations == [(200, 0)]
+    ok, _, _ = evm.call(
+        ca, STAKING_PRECOMPILE_ADDR, 0,
+        _stake_calldata(b"CollectRewards(address)", ca.rjust(32, b"\x00")),
+        200_000,
+    )
+    assert ok
+    assert state.balance(ca) == 44
+
+
+def test_staking_precompile_reverts_with_outer_frame():
+    """A contract that delegates then REVERTs must leave staking state
+    untouched (journaled set_validator)."""
+    from harmony_tpu.core.vm import STAKING_PRECOMPILE_ADDR
+
+    state = StateDB()
+    vaddr = b"\x56" * 20
+    _mk_validator(state, vaddr)
+    ca = b"\xcd" * 20
+    state.add_balance(ca, 10_000)
+    evm = _evm(state)
+    data = _stake_calldata(
+        b"Delegate(address,address,uint256)",
+        ca.rjust(32, b"\x00"), vaddr.rjust(32, b"\x00"),
+        (500).to_bytes(32, "big"),
+    )
+    # contract: calldatacopy(0,0,size); call(gas, 0xfc, 0, 0, size, 0, 0); revert(0,0)
+    code = bytes([
+        0x36, 0x60, 0x00, 0x60, 0x00, 0x37,
+        0x60, 0x00, 0x60, 0x00, 0x36, 0x60, 0x00, 0x60, 0x00,
+        0x73]) + STAKING_PRECOMPILE_ADDR + bytes([
+        0x5A, 0xF1, 0x50,
+        0x60, 0x00, 0x60, 0x00, 0xFD,
+    ])
+    state.set_code(ca, code)
+    ok, _, _ = evm.call(A, ca, 0, data, 500_000)
+    assert not ok
+    assert state.balance(ca) == 10_000
+    w = state.validator(vaddr)
+    assert all(d.delegator != ca for d in w.delegations)
+    state.end_tx()
+
+
+def test_staking_precompile_wrong_shard_fails():
+    from harmony_tpu.core.vm import STAKING_PRECOMPILE_ADDR
+
+    state = StateDB()
+    vaddr = b"\x56" * 20
+    _mk_validator(state, vaddr)
+    ca = b"\xcb" * 20
+    state.add_balance(ca, 10_000)
+    evm = EVM(state, Env(block_num=5, chain_id=2, shard_id=1),
+              origin=A, gas_price=1)
+    data = _stake_calldata(
+        b"Delegate(address,address,uint256)",
+        ca.rjust(32, b"\x00"), vaddr.rjust(32, b"\x00"),
+        (500).to_bytes(32, "big"),
+    )
+    ok, _, _ = evm.call(ca, STAKING_PRECOMPILE_ADDR, 0, data, 200_000)
+    assert not ok
+
+
+def test_eip2929_cold_then_warm_sload():
+    """First SLOAD of a slot is cold (2100), repeat is warm (100)."""
+    state = StateDB()
+    ca = b"\xce" * 20
+    # sload(7); pop; sload(7); pop; stop
+    code = bytes([0x60, 0x07, 0x54, 0x50, 0x60, 0x07, 0x54, 0x50, 0x00])
+    state.set_code(ca, code)
+    evm = _evm(state)
+    ok, gas_left, _ = evm.call(A, ca, 0, b"", 100_000)
+    assert ok
+    used = 100_000 - gas_left
+    # 2 pushes(3) + 2 pops(2) + cold 2100 + warm 100
+    assert used == 3 + 3 + 2 + 2 + 2100 + 100
+    # legacy mode: flat SLOAD_GAS
+    evm2 = EVM(StateDB(), Env(), origin=A, gas_price=1, berlin=False)
+    evm2.state.set_code(ca, code)
+    ok, gas_left2, _ = evm2.call(A, ca, 0, b"", 100_000)
+    assert ok
+    assert 100_000 - gas_left2 == 3 + 3 + 2 + 2 + 800 + 800
+
+
+def test_eip2929_access_list_reverts_with_frame():
+    """EIP-2929: an inner frame's warmed slots revert with it."""
+    state = StateDB()
+    evm = _evm(state)
+    inner = b"\xd1" * 20
+    # inner: sload(3); pop; revert(0,0)
+    state.set_code(inner, bytes([0x60, 0x03, 0x54, 0x50,
+                                 0x60, 0x00, 0x60, 0x00, 0xFD]))
+    ok, _, _ = evm.call(A, inner, 0, b"", 100_000)
+    assert not ok
+    assert (inner, (3).to_bytes(32, "big")) not in evm.warm_slots
+    state.end_tx()
+
+
+def test_call_tracer_captures_nested_calls():
+    from harmony_tpu.core.vm import CallTracer
+
+    state = StateDB()
+    tracer = CallTracer()
+    evm = EVM(state, Env(block_num=5, chain_id=2), origin=A,
+              gas_price=1, tracer=tracer)
+    inner = b"\xd2" * 20
+    state.set_code(inner, bytes([0x00]))  # stop
+    outer = b"\xd3" * 20
+    # call(gas, inner, 0, 0,0,0,0); stop
+    code = bytes([
+        0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+        0x73]) + inner + bytes([0x5A, 0xF1, 0x50, 0x00])
+    state.set_code(outer, code)
+    ok, _, _ = evm.call(A, outer, 0, b"\x99", 200_000)
+    assert ok
+    assert tracer.root["type"] == "CALL"
+    assert tracer.root["to"] == outer.hex()
+    assert tracer.root["input"] == "99"
+    assert len(tracer.root["calls"]) == 1
+    assert tracer.root["calls"][0]["to"] == inner.hex()
+    assert "gasUsed" in tracer.root
