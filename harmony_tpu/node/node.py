@@ -86,6 +86,23 @@ class Node:
         self._vc_collector = None
         self._prepared_proof: bytes | None = None  # [sig||bitmap] seen
         self._prepared_block_bytes: bytes = b""
+        # consensus-triggered sync (reference: consensus/downloader.go
+        # spinUpStateSync): a run of future-round messages means the
+        # network moved on without us — pull blocks instead of dropping
+        # gossip forever
+        self._ahead_runs = 0
+        self.ahead_threshold = 4
+        self._syncing = False
+        self._sync_done = threading.Event()
+        self.sync_spinups = 0
+        # preCommitAndPropose analog (consensus_v2.go:559-635): the
+        # leader proposes the NEXT block immediately after broadcasting
+        # COMMITTED instead of waiting for the pacing tick.  Off until
+        # run_forever arms it: deterministic test harnesses drive
+        # rounds explicitly and must not get surprise proposals.
+        self.pipelining = False
+        self.block_time = 2.0
+        self._last_propose = 0.0
 
         self.log = get_logger("consensus", shard=self.chain.shard_id)
         self.host.add_validator(self.topic, self._gossip_validator)
@@ -103,7 +120,28 @@ class Node:
         )
 
     def leader_key(self, view_id: int) -> bytes:
+        """The view's designated leader key (reference:
+        consensus/quorum/quorum.go:206-320 NthNext family).
+
+        Pre-leader-rotation epochs rotate uniformly over committee
+        slots (NthNext).  Once the LeaderRotation gate is active, the
+        rotation is OPERATOR-distinct (NthNextValidator semantics): a
+        validator running many slots still gets exactly one leadership
+        turn per cycle — otherwise stake-heavy multi-key operators
+        would hold the proposer role proportionally longer."""
         committee = self.committee()
+        epoch = self.chain.epoch_of(self.chain.head_number + 1)
+        if self.chain.config.is_leader_rotation(epoch):
+            state = self.chain.shard_state_for_epoch(epoch)
+            com = state.find_committee(self.chain.shard_id) if state else None
+            if com is not None and com.slots:
+                seen: set = set()
+                operators: list = []  # first slot key per operator
+                for s in com.slots:
+                    if s.ecdsa_address not in seen:
+                        seen.add(s.ecdsa_address)
+                        operators.append(s.bls_pubkey)
+                return operators[view_id % len(operators)]
         return committee[view_id % len(committee)]
 
     @property
@@ -202,10 +240,24 @@ class Node:
             self._reproposal = None
             self.leader.cfg.payload_view_id = block.header.view_id
         else:
-            block = self.worker.propose_block(view_id=self.view_id)
+            # epoch-randomness pipeline (reference: consensus_v2.go:955-
+            # 1034 — leader's VRF in every gated header; the Wesolowski
+            # VDF output lands via header.vdf once the delayed
+            # computation over a past epoch seed finishes)
+            vrf = b""
+            epoch = self.chain.epoch_of(self.block_num)
+            if self.chain.config.is_active("vrf", epoch) and len(self.keys):
+                from .. import crypto_vrf
+
+                _out, proof = crypto_vrf.evaluate(
+                    self.keys[0], self.chain.current_header().hash()
+                )
+                vrf = proof
+            block = self.worker.propose_block(view_id=self.view_id, vrf=vrf)
         block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
         self._pending_block = block
         self._proposed = True
+        self._last_propose = time.monotonic()
         msg = self.leader.announce(block.hash(), block_bytes)
         self.log.info(
             "announce", block=block.block_num, view=self.view_id,
@@ -218,9 +270,52 @@ class Node:
         self._leader_advance()
         return block
 
+    def _spin_up_sync(self):
+        """Run the downloader in the background until caught up, then
+        signal the pump to rejoin consensus at the new head (the
+        reference's spinUpStateSync + last-mile rejoin)."""
+        downloader = self.registry.get("downloader")
+        if downloader is None or self._syncing:
+            return
+        self._syncing = True
+        self.sync_spinups += 1
+        self._ahead_runs = 0
+        self.log.warn(
+            "behind: spinning up sync", round=self.block_num,
+            head=self.chain.head_number,
+        )
+
+        def run():
+            try:
+                for _ in range(1024):  # bounded: each pass is a batch
+                    res = downloader.sync_once()
+                    if res.caught_up:
+                        break
+            except Exception as e:  # noqa: BLE001 — rejoin regardless
+                self.log.error("sync spin-up failed", err=str(e))
+            finally:
+                self._sync_done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _finish_sync_if_done(self):
+        """Pump-side completion: re-derive the round from the synced
+        head so this node rejoins mid-consensus cleanly."""
+        if not self._syncing or not self._sync_done.is_set():
+            return
+        self._sync_done.clear()
+        self._syncing = False
+        if self.chain.head_number + 1 != self.block_num:
+            self.log.info(
+                "sync caught up: rejoining", head=self.chain.head_number,
+            )
+            self._vc = 0
+            self._new_round()
+
     def process_pending(self, max_msgs: int = 0) -> int:
         """Drain queued gossip through the FBFT handlers; returns the
         number of messages processed."""
+        self._finish_sync_if_done()
         n = 0
         while not self._stop.is_set():
             try:
@@ -242,7 +337,15 @@ class Node:
         except ValueError:
             return
         if msg.block_num != self.block_num:
-            return  # stale/future round (sync handles catch-up)
+            # stale rounds are noise; a RUN of future rounds means the
+            # network is ahead — spin up the downloader (reference:
+            # consensus/downloader.go:13-107, consensus_v2.go:498-558)
+            if msg.block_num > self.block_num:
+                self._ahead_runs += 1
+                if self._ahead_runs >= self.ahead_threshold:
+                    self._spin_up_sync()
+            return
+        self._ahead_runs = 0
         # the sender must have SIGNED this exact message — without this
         # gate any peer could replay/forge another member's ANNOUNCE /
         # PREPARED / COMMITTED (reference verifies the message signature
@@ -293,6 +396,23 @@ class Node:
             return None
         if block.tx_root(self.chain.config.chain_id) != header.tx_root:
             return None
+        if self.chain.config.is_active("vrf", header.epoch) and (
+            block.hash() != self._expected_reproposal_hash
+        ):
+            # the leader's VRF proof must verify against its key over
+            # the parent hash (consensus_v2.go ProposalVrfAndProof).
+            # Re-proposals carry the ORIGINAL proposer's VRF and were
+            # already validated under that view (M1 quorum attested).
+            from .. import bls as B
+            from .. import crypto_vrf
+
+            try:
+                crypto_vrf.verify(
+                    B.PublicKey.from_bytes(self._round_leader_key),
+                    head.hash(), header.vrf,
+                )
+            except ValueError:
+                return None
         # the carried parent commit proof drives reward/availability
         # state — it must be EXACTLY the proof this node committed for
         # the parent (all honest nodes stored the same COMMITTED
@@ -328,7 +448,7 @@ class Node:
                 state, header.block_num, header.epoch,
                 header.last_commit_bitmap or None,
             )
-            if state.root() != header.root:
+            if self.chain.config.state_root(state, header.epoch) != header.root:
                 return None
         except ValueError:
             return None
@@ -512,6 +632,17 @@ class Node:
         self._sent_prepared = False
         self._sent_committed = False
         self._new_round()
+        # preCommitAndPropose (consensus_v2.go:559-635): COMMITTED is
+        # already on the wire; if this node leads the next round and the
+        # block period has elapsed, propose NOW — proposal construction
+        # and broadcast overlap the validators' insert work instead of
+        # idling until the next pacing tick
+        if (
+            self.pipelining
+            and self.is_leader
+            and time.monotonic() - self._last_propose >= self.block_time
+        ):
+            self.start_round_if_leader()
 
     # -- view change (reference: consensus/view_change.go:220-553) ----------
 
@@ -664,13 +795,14 @@ class Node:
         ``block_time`` seconds (reference: mainnet 2 s block period,
         internal/params/config.go:740 IsTwoSeconds)."""
 
+        self.block_time = block_time
+        self.pipelining = True  # live mode: overlap COMMITTED + propose
+
         def loop():
-            last_propose = 0.0
             while not self._stop.is_set():
                 now = time.monotonic()
-                if now - last_propose >= block_time:
-                    if self.start_round_if_leader() is not None:
-                        last_propose = now
+                if now - self._last_propose >= block_time:
+                    self.start_round_if_leader()
                 if (
                     now - self._round_start > self.phase_timeout
                     and self.chain.head_number + 1 == self.block_num

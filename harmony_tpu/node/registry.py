@@ -15,6 +15,7 @@ class Registry:
     _SLOTS = (
         "blockchain", "beaconchain", "txpool", "engine", "worker",
         "host", "sync_client_factory", "webhooks", "metrics",
+        "downloader", "discovery", "explorer", "rosetta",
     )
 
     def __init__(self, **initial):

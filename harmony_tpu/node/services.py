@@ -22,6 +22,8 @@ class ServiceType(IntEnum):
     SYNCHRONIZE = 6
     CROSSLINK_SENDING = 7
     PPROF = 8
+    ROSETTA = 9    # this framework's ids; the reference serves rosetta
+    WEBSOCKET = 10  # and WS from its RPC stack, not service slots
 
 
 class Service:
