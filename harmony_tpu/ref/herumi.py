@@ -1,0 +1,212 @@
+"""herumi/mcl interop ciphersuite: the reference chain's wire format.
+
+The reference signs and verifies through the herumi bls library built
+with BLS_SWAP_G=1 — pubkeys in G1 (48 B), signatures in G2 (96 B)
+(reference: crypto/bls/bls.go:17-20, Makefile:70) — using mcl's
+*default* (pre-IETF) serialization, NOT the ZCash/IETF encoding that
+``ref/serialize.py`` implements.  This module provides the mcl side as
+a selectable ciphersuite so keys and committee tables produced by the
+real chain load byte-for-byte.
+
+Empirically pinned conventions (validated in tests/test_herumi.py
+against data vendored from the reference repo — no herumi code was
+available or consulted, only its outputs):
+
+* Field elements serialize LITTLE-endian (Fp: 48 B, Fr: 32 B).
+  Validated: all foundational-committee pubkeys in
+  reference internal/genesis/foundational.go decode to curve points
+  under LE (and none do under BE, which overflows p).
+* G1/G2 compressed form: x little-endian with the y-parity flag in the
+  MOST significant bit of the final byte (0x80 of byte 47 / 95); the
+  all-zero buffer is the point at infinity.  Parity semantics: flag set
+  <=> y is odd (mcl convention); our vendored (sk, pk) vector decodes
+  with all flag bits clear and an even y, consistent with it.
+* G2 x = a + b*u serializes a (real component) first, then b, each
+  48 B LE, flag on the global final byte.
+* The BLS_SWAP_G G1 base point is NOT the standard BLS12-381 generator.
+  HERUMI_G1 below is derived from the reference's test vector pair
+  (core/tx_pool_test.go:52-53): G = sk^-1 * pk with sk read LE — the
+  unique point satisfying pk = sk*G for that pair.
+
+NOT yet vector-validated (requires herumi-produced signatures, which
+neither this image nor the reference repo contains): the SignHash
+map-to-G2 — mcl's try-and-increment from the 32-byte message hash —
+including its sqrt-root choice and cofactor-clearing method.
+``map_to_g2_herumi`` implements the documented mcl "original" shape
+(x = hash-as-Fp + 0*u; x += 1 until x^3 + 4(u+1) is square; plain-h2
+cofactor clear) with the root choice isolated in ``_choose_root`` so a
+single line flips when vectors become available.  Signatures produced
+and verified WITHIN this framework using the herumi suite are
+self-consistent either way.
+"""
+
+from . import fields as F
+from .curve import g1, g2
+from .params import H2, P, R_ORDER
+
+# The BLS_SWAP_G base point (see module docstring for derivation).
+HERUMI_G1 = (
+    763293344507811477046371684537583630275805851521468330676434473029673297697877452371442185900362942157156173349093,
+    2781315704910118183567811941392363931476590133721789378765638560267023127619616760929191718052242275548019548370600,
+)
+
+_ODD_FLAG = 0x80  # MSB of the final byte: y is odd
+
+
+# ----------------------------------------------------------------------
+# scalars
+# ----------------------------------------------------------------------
+
+
+def fr_to_bytes(sk: int) -> bytes:
+    return (sk % R_ORDER).to_bytes(32, "little")
+
+
+def fr_from_bytes(data: bytes) -> int:
+    if len(data) != 32:
+        raise ValueError("herumi Fr must be 32 bytes")
+    v = int.from_bytes(data, "little")
+    if v >= R_ORDER:
+        raise ValueError("herumi Fr out of range")
+    return v
+
+
+# ----------------------------------------------------------------------
+# points
+# ----------------------------------------------------------------------
+
+
+def g1_serialize(pt) -> bytes:
+    if pt is None:
+        return bytes(48)
+    x, y = pt
+    out = bytearray(x.to_bytes(48, "little"))
+    if y & 1:
+        out[47] |= _ODD_FLAG
+    return bytes(out)
+
+
+def g1_deserialize(data: bytes, check_subgroup: bool = True):
+    if len(data) != 48:
+        raise ValueError("herumi G1 must be 48 bytes")
+    if not any(data):
+        return None
+    odd = bool(data[47] & _ODD_FLAG)
+    x = int.from_bytes(data[:47] + bytes([data[47] & 0x7F]), "little")
+    if x >= P:
+        raise ValueError("herumi G1 x out of range")
+    y = F.fp_sqrt((x * x % P * x + g1.b) % P)
+    if y is None:
+        raise ValueError("herumi G1 x not on curve")
+    if bool(y & 1) != odd:
+        y = (-y) % P
+    pt = (x, y)
+    # rogue-point defense, as in serialize.py: mcl's verifyOrder
+    if check_subgroup and g1.mul(pt, R_ORDER) is not None:
+        raise ValueError("herumi G1 point not in the r-torsion subgroup")
+    return pt
+
+
+def _fp2_is_odd(a) -> bool:
+    """mcl Fp2 parity: the parity of the real component unless it is
+    zero, in which case the imaginary component's (isOdd of a.a or,
+    when a.a == 0, of a.b)."""
+    return bool((a[0] & 1) if a[0] else (a[1] & 1))
+
+
+def g2_serialize(pt) -> bytes:
+    if pt is None:
+        return bytes(96)
+    x, y = pt
+    out = bytearray(
+        x[0].to_bytes(48, "little") + x[1].to_bytes(48, "little")
+    )
+    if _fp2_is_odd(y):
+        out[95] |= _ODD_FLAG
+    return bytes(out)
+
+
+def g2_deserialize(data: bytes, check_subgroup: bool = True):
+    if len(data) != 96:
+        raise ValueError("herumi G2 must be 96 bytes")
+    if not any(data):
+        return None
+    odd = bool(data[95] & _ODD_FLAG)
+    a = int.from_bytes(data[:48], "little")
+    b = int.from_bytes(data[48:95] + bytes([data[95] & 0x7F]), "little")
+    if a >= P or b >= P:
+        raise ValueError("herumi G2 x out of range")
+    x = (a, b)
+    rhs = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g2.b)
+    y = F.fp2_sqrt(rhs)
+    if y is None:
+        raise ValueError("herumi G2 x not on curve")
+    if _fp2_is_odd(y) != odd:
+        y = F.fp2_neg(y)
+    pt = (x, y)
+    if check_subgroup and g2.mul(pt, R_ORDER) is not None:
+        raise ValueError("herumi G2 point not in the r-torsion subgroup")
+    return pt
+
+
+# ----------------------------------------------------------------------
+# SignHash-shaped map to G2 (see module docstring: pending vectors)
+# ----------------------------------------------------------------------
+
+
+def _choose_root(y, neg):
+    """mcl sqrt root choice — the one unpinned convention.  We take the
+    even-parity root (mcl Fp2 parity, see _fp2_is_odd); flip here if
+    herumi vectors disagree."""
+    return neg if _fp2_is_odd(y) else y
+
+
+def map_to_g2_herumi(msg_hash: bytes):
+    """mcl-original-shaped SignHash map: interpret the hash LE as an Fp
+    element t (mcl setArrayMask), start from x = t + 0*u, and increment
+    by one until x^3 + 4(u+1) is a square; clear the cofactor by h2.
+
+    Reference call shape: consensus/construct.go:99-114 signs 32-byte
+    block hashes via priKey.SignHash."""
+    if not msg_hash:
+        raise ValueError("empty message hash")
+    # setArrayMask: LE interpretation masked below 2^380 (< p)
+    t = int.from_bytes(msg_hash, "little")
+    t &= (1 << 380) - 1
+    t %= P
+    x = (t, 0)
+    for _ in range(512):
+        rhs = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g2.b)
+        y = F.fp2_sqrt(rhs)
+        if y is not None:
+            y = _choose_root(y, F.fp2_neg(y))
+            pt = g2.mul((x, y), H2)
+            if pt is not None:
+                return pt
+        x = (F.fp_add(x[0], 1), x[1])
+    raise ValueError("map_to_g2_herumi: no point found (p < 2^-512)")
+
+
+# ----------------------------------------------------------------------
+# BLS over the herumi suite
+# ----------------------------------------------------------------------
+
+
+def pubkey(sk: int):
+    return g1.mul(HERUMI_G1, sk % R_ORDER)
+
+
+def sign_hash(sk: int, msg_hash: bytes):
+    return g2.mul(map_to_g2_herumi(msg_hash), sk % R_ORDER)
+
+
+def verify_hash(pk, msg_hash: bytes, sig) -> bool:
+    """e(-G_herumi, sig) * e(pk, H(m)) == 1."""
+    from . import pairing as RP
+    from .fields import FP12_ONE
+
+    if pk is None or sig is None:
+        return False
+    h = map_to_g2_herumi(msg_hash)
+    gt = RP.multi_pairing([(g1.neg(HERUMI_G1), sig), (pk, h)])
+    return gt == FP12_ONE

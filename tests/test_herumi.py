@@ -1,0 +1,122 @@
+"""herumi/mcl interop ciphersuite vectors (ref/herumi.py).
+
+All vectors are data vendored from the reference repo — the outputs of
+the herumi library the real chain runs, not its code:
+
+* SK_HEX / PK_HEX: the (secret, public) pair hardcoded in reference
+  core/tx_pool_test.go:52-53 (same pair in test/chain/reward/main.go).
+* MAINNET_PUBKEYS: the first 16 foundational-committee BLS public keys
+  from reference internal/genesis/foundational.go:5-20 — real mainnet
+  wire bytes.
+"""
+
+import pytest
+
+from harmony_tpu.ref import herumi as H
+from harmony_tpu.ref.curve import g1, g2
+from harmony_tpu.ref.params import G1_X, G1_Y, R_ORDER
+
+SK_HEX = "c6d7603520311f7a4e6aac0b26701fc433b75b38df504cd416ef2b900cd66205"
+PK_HEX = (
+    "30b2c38b1316da91e068ac3bd8751c0901ef6c02a1d58bc712104918302c6ed0"
+    "3d5894671d0c816dad2b4d303320f202"
+)
+
+MAINNET_PUBKEYS = [
+    "9e70e8d76851f6e8dc648255acdd57bb5c49cdae7571aed43f86e9f140a6343caed2ffa860919d03e0912411fee4850a",
+    "fce3097d9fc234d34d6eaef3eecd0365d435d1118f69f2da1ed2a69ba725270771572e40347c222aca784cb973307b11",
+    "edb61007e99af30191098f2cd6f787e2f53fb595bf63fcb4d31a386e7070f7a4fdcefd3e896080a665dc19fecbafc306",
+    "475b5c3bbbda60cd92951e44bbea2aac63f1b774652d6bbec86aaed0dabd10a46717e98763d559b63bc4f1bfbde66908",
+    "f7af1b02f35cdfb3ef2ac7cdccb87cf20f5411922170e4e191d57d6d1f52901a7c6e363d266a1c86bb1aef651bd1ae96",
+    "f400d1caa1f40a14d870640c50d895205014f5b54c3aa9661579b937ea5bcc2f159b9bbb8075b516628f545af822180f",
+    "bfa025fd7799315e528be8a985d1ab4a90506fca94db7e1f88d29d0f8e8221af742a0f8e9f7f9fbe71c1beca2a6c9690",
+    "eb4d1c141fc6319f32710212b78b88a045ce95437025bfca56ec399cdcd469d1c49081025f859e09b35249cf2cc6bf06",
+    "bbd0b173ace9f35c22eb80fe4673497f55c7039f089a3444a329f760f0d4a335927bb7d94a70b817c405351570f3d411",
+    "714fb47f27b4d300320e06e37e973e0a9cfa647f7bdb915262d7fe500252a777f37d8d358dc07b27c7eef88a7521ad06",
+    "663f82d48ff61d09bb215836f853e838df7da62aa90344dcf7950c18378dae909895c0c179c2dd71ea77fa747af53106",
+    "1e9f5f68845634efca8a64e8ffcf90d63ec196f28fb64f688fb88b868728ab562b702af8414f48c5d045e94433ec5a87",
+    "43b1376eff41dfdccaeb601edc09b4353e5abd343a90740ecb3f9aac882321361e01267ffd2a0e2115755b5148b1f115",
+    "43f5ed2b60cb88c64dc16c4c3527943eb92a15f75967cf37ef3a9a8171da5a59685c198c981a9fd471ffc299fe699887",
+    "b01f1752fdbe3d21cc9cf9dc3d1a781b216fae48d34a4c3866e36cc686c4d955f66d9bd0bd608ccb3b54565c9125fc12",
+    "23ab4b6415a53e3ac398b53e9df5376f28c024e3d300fa9a6ed8c3c867929c43e81f978f8ba02bacd5f956dc2d3a6399",
+]
+
+
+def test_reference_keypair_roundtrips_exactly():
+    """sk -> pk must reproduce the reference's bytes bit-for-bit: this
+    pins the Fr endianness, the BLS_SWAP_G base point, and the G1
+    serialization (LE + odd-y MSB flag) all at once."""
+    sk = H.fr_from_bytes(bytes.fromhex(SK_HEX))
+    pk = H.pubkey(sk)
+    assert H.g1_serialize(pk).hex() == PK_HEX
+    assert H.g1_deserialize(bytes.fromhex(PK_HEX)) == pk
+    assert H.fr_to_bytes(sk).hex() == SK_HEX
+
+
+def test_base_point_is_in_subgroup_and_nonstandard():
+    assert g1.mul(H.HERUMI_G1, R_ORDER) is None  # r-torsion
+    assert H.HERUMI_G1 != (G1_X, G1_Y)  # NOT the IETF generator
+
+
+@pytest.mark.parametrize("hexkey", MAINNET_PUBKEYS)
+def test_mainnet_genesis_pubkeys_roundtrip(hexkey):
+    """Every real mainnet committee key must deserialize to a valid
+    r-torsion G1 point and re-serialize byte-identically."""
+    data = bytes.fromhex(hexkey)
+    pt = H.g1_deserialize(data)
+    assert pt is not None
+    assert H.g1_serialize(pt) == data
+
+
+def test_g1_rejects_out_of_range_and_bad_points():
+    from harmony_tpu.ref.params import P
+
+    bad = bytearray(P.to_bytes(48, "little"))
+    with pytest.raises(ValueError):
+        H.g1_deserialize(bytes(bad))
+    with pytest.raises(ValueError):
+        H.g1_deserialize(b"\x01" + bytes(46))  # wrong length
+    assert H.g1_deserialize(bytes(48)) is None  # infinity
+    assert H.g1_serialize(None) == bytes(48)
+
+
+def test_g2_signature_roundtrip():
+    sk = H.fr_from_bytes(bytes.fromhex(SK_HEX))
+    sig = H.sign_hash(sk, b"\x11" * 32)
+    data = H.g2_serialize(sig)
+    assert len(data) == 96
+    assert H.g2_deserialize(data) == sig
+    assert H.g2_deserialize(bytes(96)) is None
+    assert H.g2_serialize(None) == bytes(96)
+
+
+def test_sign_hash_verify_and_reject():
+    sk = H.fr_from_bytes(bytes.fromhex(SK_HEX))
+    pk = H.pubkey(sk)
+    msg = b"\x22" * 32
+    sig = H.sign_hash(sk, msg)
+    assert H.verify_hash(pk, msg, sig)
+    assert not H.verify_hash(pk, b"\x23" * 32, sig)
+    assert not H.verify_hash(pk, msg, g2.neg(sig))
+
+
+def test_aggregate_over_herumi_suite():
+    sks = [H.fr_from_bytes(bytes([i + 1] * 32)) % R_ORDER for i in range(3)]
+    sks = [sk if sk else 1 for sk in sks]
+    msg = b"\x33" * 32
+    pks = [H.pubkey(sk) for sk in sks]
+    sigs = [H.sign_hash(sk, msg) for sk in sks]
+    agg_sig = None
+    agg_pk = None
+    for s, p in zip(sigs, pks):
+        agg_sig = g2.add(agg_sig, s)
+        agg_pk = g1.add(agg_pk, p)
+    assert H.verify_hash(agg_pk, msg, agg_sig)
+
+
+def test_map_to_g2_is_deterministic_and_torsion():
+    h1 = H.map_to_g2_herumi(b"\x44" * 32)
+    h2_ = H.map_to_g2_herumi(b"\x44" * 32)
+    assert h1 == h2_
+    assert g2.mul(h1, R_ORDER) is None
+    assert H.map_to_g2_herumi(b"\x45" * 32) != h1
