@@ -1,0 +1,179 @@
+"""Contract ABI codec: Solidity argument encoding + selectors.
+
+The role of the reference's accounts/abi (go-ethereum fork, consumed
+by e.g. staking/precompile.go's method dispatch).  Supports the ABI
+head/tail encoding for: address, bool, uintN/intN, bytesN, bytes,
+string, fixed arrays T[k], and dynamic arrays T[].  Types are given as
+strings ("uint256", "address[]", "bytes32[4]").
+"""
+
+from __future__ import annotations
+
+from ..ref.keccak import keccak256
+
+
+def function_selector(signature: str) -> bytes:
+    """keccak('Name(type1,type2)')[:4]."""
+    return keccak256(signature.encode())[:4]
+
+
+def _is_dynamic(typ: str) -> bool:
+    if typ.endswith("]"):
+        base, _, count = typ.rpartition("[")
+        if count == "]":  # T[]
+            return True
+        return _is_dynamic(base)
+    return typ in ("bytes", "string")
+
+
+def _pad32(b: bytes, left: bool = True) -> bytes:
+    if len(b) > 32:
+        raise ValueError("value exceeds one word")
+    return b.rjust(32, b"\x00") if left else b.ljust(32, b"\x00")
+
+
+def _enc_head(typ: str, value) -> bytes:
+    if typ == "address":
+        if isinstance(value, str):
+            value = bytes.fromhex(value[2:] if value.startswith("0x")
+                                  else value)
+        if len(value) != 20:
+            raise ValueError("address must be 20 bytes")
+        return _pad32(value)
+    if typ == "bool":
+        return _pad32(b"\x01" if value else b"\x00")
+    if typ.startswith("uint"):
+        bits = int(typ[4:] or 256)
+        v = int(value)
+        if v < 0 or v >= 1 << bits:
+            raise ValueError(f"{typ} out of range")
+        return _pad32(v.to_bytes(32, "big"))
+    if typ.startswith("int"):
+        bits = int(typ[3:] or 256)
+        v = int(value)
+        if v < -(1 << (bits - 1)) or v >= 1 << (bits - 1):
+            raise ValueError(f"{typ} out of range")
+        return v.to_bytes(32, "big", signed=True)
+    if typ.startswith("bytes") and typ != "bytes":
+        n = int(typ[5:])
+        if not 1 <= n <= 32 or len(value) != n:
+            raise ValueError(f"bad {typ} value")
+        return _pad32(value, left=False)
+    raise ValueError(f"not a static head type: {typ}")
+
+
+def _enc_dynamic(typ: str, value) -> bytes:
+    if typ in ("bytes", "string"):
+        raw = value.encode() if isinstance(value, str) else bytes(value)
+        padded = raw.ljust((len(raw) + 31) // 32 * 32, b"\x00")
+        return _pad32(len(raw).to_bytes(32, "big")) + padded
+    if typ.endswith("[]"):
+        base = typ[:-2]
+        return (
+            _pad32(len(value).to_bytes(32, "big"))
+            + abi_encode([base] * len(value), list(value))
+        )
+    if typ.endswith("]"):  # fixed array of dynamic elements
+        base, _, count = typ.rpartition("[")
+        k = int(count[:-1])
+        if len(value) != k:
+            raise ValueError(f"expected {k} elements")
+        return abi_encode([base] * k, list(value))
+    raise ValueError(f"not a dynamic type: {typ}")
+
+
+def abi_encode(types: list, values: list) -> bytes:
+    """The head/tail tuple encoding."""
+    if len(types) != len(values):
+        raise ValueError("types/values length mismatch")
+    heads, tails = [], []
+    # static fixed arrays inline their element heads
+    head_size = 0
+    sizes = []
+    for t in types:
+        if _is_dynamic(t):
+            sizes.append(32)
+        elif t.endswith("]"):
+            base, _, count = t.rpartition("[")
+            sizes.append(32 * int(count[:-1]))
+        else:
+            sizes.append(32)
+        head_size += sizes[-1]
+    offset = head_size
+    for t, v in zip(types, values):
+        if _is_dynamic(t):
+            tail = _enc_dynamic(t, v)
+            heads.append(_pad32(offset.to_bytes(32, "big")))
+            tails.append(tail)
+            offset += len(tail)
+        elif t.endswith("]"):
+            base, _, count = t.rpartition("[")
+            k = int(count[:-1])
+            if len(v) != k:
+                raise ValueError(f"expected {k} elements")
+            heads.append(b"".join(_enc_head(base, e) for e in v))
+        else:
+            heads.append(_enc_head(t, v))
+    return b"".join(heads) + b"".join(tails)
+
+
+def encode_call(signature: str, values: list) -> bytes:
+    """'Delegate(address,address,uint256)' + values -> calldata."""
+    inner = signature[signature.index("(") + 1:signature.rindex(")")]
+    types = [t.strip() for t in inner.split(",")] if inner else []
+    return function_selector(signature) + abi_encode(types, values)
+
+
+# -- decoding ----------------------------------------------------------------
+
+
+def _dec_head(typ: str, word: bytes):
+    if typ == "address":
+        return word[12:]
+    if typ == "bool":
+        return word[-1] != 0
+    if typ.startswith("uint"):
+        return int.from_bytes(word, "big")
+    if typ.startswith("int"):
+        return int.from_bytes(word, "big", signed=True)
+    if typ.startswith("bytes") and typ != "bytes":
+        return word[: int(typ[5:])]
+    raise ValueError(f"not a static head type: {typ}")
+
+
+def _dec_dynamic(typ: str, data: bytes, at: int):
+    if typ in ("bytes", "string"):
+        ln = int.from_bytes(data[at:at + 32], "big")
+        raw = data[at + 32:at + 32 + ln]
+        if len(raw) != ln:
+            raise ValueError("truncated dynamic value")
+        return raw.decode() if typ == "string" else raw
+    if typ.endswith("[]"):
+        base = typ[:-2]
+        n = int.from_bytes(data[at:at + 32], "big")
+        if n > 1 << 20:
+            raise ValueError("array length too large")
+        return abi_decode([base] * n, data[at + 32:])
+    raise ValueError(f"not a dynamic type: {typ}")
+
+
+def abi_decode(types: list, data: bytes) -> list:
+    out = []
+    off = 0
+    for t in types:
+        if _is_dynamic(t):
+            at = int.from_bytes(data[off:off + 32], "big")
+            out.append(_dec_dynamic(t, data, at))
+            off += 32
+        elif t.endswith("]"):
+            base, _, count = t.rpartition("[")
+            k = int(count[:-1])
+            out.append([
+                _dec_head(base, data[off + 32 * i:off + 32 * (i + 1)])
+                for i in range(k)
+            ])
+            off += 32 * k
+        else:
+            out.append(_dec_head(t, data[off:off + 32]))
+            off += 32
+    return out
