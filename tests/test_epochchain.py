@@ -1,0 +1,86 @@
+"""EpochChain: beacon epoch-boundary light chain (reference:
+core/epochchain.go — VERDICT r2 weak #9's missing EpochChain analog)."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.chain.engine import Engine, EpochContext
+from harmony_tpu.chain.header import Header
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.consensus.signature import construct_commit_payload
+from harmony_tpu.core.epochchain import EpochChain, EpochChainError
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.shard.committee import Committee, Slot, State
+
+N = 4
+
+
+@pytest.fixture(scope="module")
+def committee():
+    keys = [B.PrivateKey.generate(bytes([70 + i])) for i in range(N)]
+    serialized = [k.pub.bytes for k in keys]
+    return keys, serialized
+
+
+def _seal(header, keys, idx):
+    payload = construct_commit_payload(
+        header.hash(), header.block_num, header.view_id, True
+    )
+    sigs = [keys[i].sign_hash(payload) for i in idx]
+    agg = B.aggregate_sigs(sigs)
+    mask = Mask([k.pub.point for k in keys])
+    for i in idx:
+        mask.set_bit(i, True)
+    return agg.bytes, mask.mask_bytes()
+
+
+def _elected_state(serialized, shard_id=1):
+    return State(epoch=1, shards=[Committee(
+        shard_id=shard_id,
+        slots=[Slot(ecdsa_address=bytes([i]) * 20, bls_pubkey=k)
+               for i, k in enumerate(serialized)],
+    )])
+
+
+def test_epochchain_insert_and_committee_resolution(committee):
+    keys, serialized = committee
+    eng = Engine(lambda s, e: EpochContext(serialized), device=False)
+    ec = EpochChain(MemKV(), lambda s: serialized, engine=eng)
+    # genesis committee resolves at epoch 0 without any insert
+    assert ec.committee_for(1, 0) == serialized
+    assert ec.committee_for(1, 5) == []  # unseen epoch: fail closed
+
+    h = Header(shard_id=0, block_num=16, epoch=0, view_id=16,
+               shard_state=b"elected")
+    sig, bitmap = _seal(h, keys, [0, 1, 2])
+    ec.insert(h, _elected_state(serialized), sig, bitmap)
+    assert ec.head_epoch() == 0
+    got = ec.header_for_epoch(0)
+    assert got is not None and got.hash() == h.hash()
+    # next epoch's committee now resolves
+    assert ec.committee_for(1, 1) == serialized
+
+
+def test_epochchain_rejects_bad_seal_and_non_epoch_block(committee):
+    keys, serialized = committee
+    eng = Engine(lambda s, e: EpochContext(serialized), device=False)
+    ec = EpochChain(MemKV(), lambda s: serialized, engine=eng)
+    h = Header(shard_id=0, block_num=16, epoch=0, view_id=16)
+    sig, bitmap = _seal(h, keys, [0, 1, 2])
+    with pytest.raises(EpochChainError):
+        ec.insert(h, None, sig, bitmap)  # no shard state: not epoch blk
+    # under-quorum seal rejected before any write
+    sig2, bitmap2 = _seal(h, keys, [0])
+    with pytest.raises(EpochChainError):
+        ec.insert(h, _elected_state(serialized), sig2, bitmap2)
+    assert ec.head_epoch() is None
+
+
+def test_epochchain_idempotent_reinsert(committee):
+    keys, serialized = committee
+    ec = EpochChain(MemKV(), lambda s: serialized)  # no engine: test tier
+    h = Header(shard_id=0, block_num=16, epoch=0, view_id=16)
+    ec.insert(h, _elected_state(serialized))
+    h2 = Header(shard_id=0, block_num=17, epoch=0, view_id=17)
+    ec.insert(h2, _elected_state(serialized))  # same epoch: no-op
+    assert ec.header_for_epoch(0).hash() == h.hash()
