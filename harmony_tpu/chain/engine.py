@@ -38,6 +38,7 @@ class EpochContext:
         self.points = [RB.pubkey_from_bytes(k) for k in committee_keys]
         self.decider = Decider(policy, committee_keys, roster)
         self._device_aff = None
+        self._table = None
 
     def device_table(self):
         import jax.numpy as jnp
@@ -47,6 +48,14 @@ class EpochContext:
         if self._device_aff is None:
             self._device_aff = jnp.asarray(I.g1_batch_affine(self.points))
         return self._device_aff
+
+    def committee_table(self):
+        """Padded device-resident table for the fused agg_verify path."""
+        from .. import device as DV
+
+        if self._table is None:
+            self._table = DV.CommitteeTable(self.points)
+        return self._table
 
     def __len__(self):
         return len(self.serialized)
@@ -96,14 +105,21 @@ class Engine:
     """Header signature verification with epoch-ctx + verified-sig caches."""
 
     def __init__(self, committee_provider, sig_cache_size: int = 4096,
-                 device: bool | None = None):
+                 device: bool | None = None, backend=None):
         """committee_provider(shard_id, epoch) -> EpochContext.
 
         ``device=None`` (default) resolves automatically: the TPU ops
         when JAX's default backend is an accelerator, the host bigint
         twin on the CPU-only test image (where XLA's persistent-cache/
         compile machinery is unreliable — see tests/conftest.py).
-        Device-path correctness is covered by the ops parity suite."""
+        Device-path correctness is covered by the ops parity suite.
+
+        ``backend``: an out-of-process verification service with the
+        SidecarClient surface (set_committee / agg_verify) — SURVEY
+        §7.3's accelerator sidecar.  When set, quorum checks ship the
+        (bitmap, payload, sig) triple over the wire and the sidecar
+        owns the committee tables + device dispatch; the in-process
+        paths above are bypassed."""
         if device is None:
             from .. import device as DV
 
@@ -112,6 +128,21 @@ class Engine:
         self._epoch_ctx: dict = {}
         self._verified = _LRU(sig_cache_size)
         self.device = device
+        self.backend = backend
+        self._backend_committees: set = set()  # (shard, epoch) pushed
+
+    def _backend_verify(self, ctx: EpochContext, header: Header,
+                        payload: bytes, sig_bytes: bytes,
+                        bitmap: bytes) -> bool:
+        key = (header.shard_id, header.epoch)
+        if key not in self._backend_committees:
+            self.backend.set_committee(
+                header.epoch, header.shard_id, list(ctx.serialized)
+            )
+            self._backend_committees.add(key)
+        return self.backend.agg_verify(
+            header.epoch, header.shard_id, payload, bitmap, sig_bytes
+        )
 
     def epoch_context(self, shard_id: int, epoch: int) -> EpochContext:
         key = (shard_id, epoch)
@@ -151,15 +182,27 @@ class Engine:
             return False
         if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
             return False
-        agg_pk = mask.aggregate_public(device=self.device)
-        if agg_pk is None:
-            return False
         payload = self._commit_payload(header, is_staking)
+        if self.backend is not None:
+            ok = self._backend_verify(ctx, header, payload, sig_bytes, bitmap)
+            if not ok:
+                return False
+            self._verified.put(cache_key)
+            return True
         if self.device:
+            # fused path: committee table stays device-resident; the
+            # masked G1 tree-sum AND the pairing check run as ONE
+            # program — no host affine round-trip (the r2 path paid
+            # two dispatches + a host conversion per check)
             from .. import device as DV
 
-            ok = DV.verify_on_device(agg_pk, payload, sig)
+            ok = DV.agg_verify_on_device(
+                ctx.committee_table(), mask.bit_vector(), payload, sig
+            )
         else:
+            agg_pk = mask.aggregate_public(device=False)
+            if agg_pk is None:
+                return False
             ok = RB.verify(agg_pk, payload, sig)
         if not ok:
             return False
@@ -190,11 +233,6 @@ class Engine:
         bool for the whole batch or a per-item list (a batch spanning
         the staking-epoch boundary changes the commit payload shape).
         """
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ..ops import bls as OB
-        from ..ops import interop as I
         from ..ref.hash_to_curve import hash_to_g2
 
         flags = (
@@ -205,7 +243,12 @@ class Engine:
         if len(flags) != len(items):
             raise ValueError("is_staking list length != items length")
         results = [False] * len(items)
-        survivors = []  # (index, pk_point, h_point, sig_point)
+        # survivors grouped by committee context: each group runs as one
+        # fused device batch (bitmaps + hashed payloads + sigs in, bools
+        # out — the masked aggregations happen ON DEVICE, not as N
+        # host G1 adds per header as in r2)
+        groups: dict = {}  # id(ctx) -> (ctx, [(idx, bits, h_pt, sig)])
+        host_survivors = []  # (idx, agg_pk, h_pt, sig) — host path only
         for idx, (header, sig_bytes, bitmap) in enumerate(items):
             cache_key = (header.hash(), sig_bytes, bitmap)
             if cache_key in self._verified:
@@ -218,37 +261,35 @@ class Engine:
                 continue
             if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
                 continue
-            agg_pk = mask.aggregate_public(device=False)
-            if agg_pk is None:
-                continue
             payload = self._commit_payload(header, flags[idx])
             h_pt = hash_to_g2(payload)
-            survivors.append((idx, agg_pk, h_pt, sig))
+            if self.device:
+                groups.setdefault(id(ctx), (ctx, []))[1].append(
+                    (idx, mask.bit_vector(), h_pt, sig)
+                )
+            else:
+                agg_pk = mask.aggregate_public(device=False)
+                if agg_pk is None:
+                    continue
+                host_survivors.append((idx, agg_pk, h_pt, sig))
         if not self.device:
-            for idx, agg_pk, h_pt, sig in survivors:
+            for idx, agg_pk, h_pt, sig in host_survivors:
                 if RB.verify_hashed(agg_pk, h_pt, sig):
                     results[idx] = True
                     header, sig_bytes, bitmap = items[idx]
                     self._verified.put((header.hash(), sig_bytes, bitmap))
             return results
-        widest = verify_buckets()[-1]
-        for chunk_start in range(0, len(survivors), widest):
-            chunk = survivors[chunk_start:chunk_start + widest]
-            n, padded = len(chunk), bucket_size(len(chunk))
-            # pad with copies of the first element: results are sliced
-            # back to n, so pad lanes are never consulted
-            sel = list(range(n)) + [0] * (padded - n)
-            pk = np.asarray(I.g1_batch_affine([chunk[i][1] for i in sel]))
-            hh = np.asarray(I.g2_batch_affine([chunk[i][2] for i in sel]))
-            sg = np.asarray(I.g2_batch_affine([chunk[i][3] for i in sel]))
-            from .. import device as DV
+        from .. import device as DV
 
-            ok = np.asarray(
-                OB.verify(jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg))
-            )[:n]
-            DV.COUNTERS["batch_verify"] += 1
-            for (idx, _, _, _), good in zip(chunk, ok):
-                if bool(good):
+        for ctx, entries in groups.values():
+            ok = DV.agg_verify_batch_on_device(
+                ctx.committee_table(),
+                [e[1] for e in entries],
+                [e[2] for e in entries],
+                [e[3] for e in entries],
+            )
+            for (idx, _, _, _), good in zip(entries, ok):
+                if good:
                     results[idx] = True
                     header, sig_bytes, bitmap = items[idx]
                     self._verified.put((header.hash(), sig_bytes, bitmap))

@@ -238,7 +238,11 @@ class Validator(_Node):
         """Decode [sig || bitmap], check quorum-by-mask, verify the
         aggregate signature — the reference's validator-side check
         (validator.go:217-236; engine.go:619-642 uses the same shape).
-        Malformed payloads return False, never raise."""
+        Malformed payloads return False, never raise.
+
+        Device path: the committee lives as one device-resident table
+        and the masked aggregation + pairing check run FUSED as a
+        single program (ops/bls.agg_verify) — bitmap in, bool out."""
         from .. import device as DV
 
         try:
@@ -249,13 +253,20 @@ class Validator(_Node):
             mask.set_mask(bitmap)
             if not self.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
                 return False
-            agg_pk = mask.aggregate_public(device=DV.device_enabled())
-            if agg_pk is None:
-                return False
             sig = B.Signature.from_bytes(sig_bytes)
         except ValueError:
             return False
-        return B.verify_point(agg_pk, payload, sig.point)
+        if DV.device_enabled():
+            table = DV.get_committee_table(
+                self.cfg.committee, self.committee_points
+            )
+            return DV.agg_verify_on_device(
+                table, mask.bit_vector(), payload, sig.point
+            )
+        agg_pk = mask.aggregate_public(device=False)
+        if agg_pk is None:
+            return False
+        return RB.verify(agg_pk, payload, sig.point)
 
     def on_prepared(self, msg: FBFTMessage):
         """Verify the prepare proof; if valid, send the commit vote

@@ -24,6 +24,72 @@ _LOCK = threading.Lock()
 
 COUNTERS = {"verify": 0, "agg_verify": 0, "batch_verify": 0}
 
+# Committee tables are padded to one of these pinned sizes so every
+# epoch/committee shares a small set of compiled programs (pad keys are
+# affine (0,0) = infinity, masked off by zero bitmap bits).
+COMMITTEE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def committee_bucket(n: int) -> int:
+    for b in COMMITTEE_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + COMMITTEE_BUCKETS[-1] - 1)
+            // COMMITTEE_BUCKETS[-1]) * COMMITTEE_BUCKETS[-1]
+
+
+class CommitteeTable:
+    """A committee's pubkeys as ONE device-resident padded affine tensor
+    — the epoch-keyed table of SURVEY §7.3 that lets steady-state quorum
+    checks ship only a bitmap + 96-byte signature to the device."""
+
+    def __init__(self, points):
+        import numpy as np
+
+        from .ops import interop as I
+
+        self.n = len(points)
+        self.size = committee_bucket(max(self.n, 1))
+        arr = np.zeros((self.size, 2, 32), dtype=np.int32)
+        if self.n:
+            arr[: self.n] = I.g1_batch_affine(points)
+        self._np = arr
+        self._dev = None
+
+    def device_array(self):
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            self._dev = jnp.asarray(self._np)
+        return self._dev
+
+    def pad_bits(self, bits):
+        import numpy as np
+
+        out = np.zeros((self.size,), dtype=np.int32)
+        out[: self.n] = np.asarray(bits, dtype=np.int32)[: self.n]
+        return out
+
+
+_TABLE_CACHE: "dict[tuple, CommitteeTable]" = {}
+_TABLE_CACHE_CAP = 8
+
+
+def get_committee_table(serialized_keys, points) -> CommitteeTable:
+    """Per-committee table cache: a fresh FBFT Validator is built every
+    round, but the committee changes only at epoch boundaries — the
+    host->device conversion must amortize across rounds, not re-run
+    per block.  Keyed by the serialized key tuple; bounded (a node
+    tracks at most its own + a few foreign committees at once)."""
+    key = tuple(serialized_keys)
+    tbl = _TABLE_CACHE.get(key)
+    if tbl is None:
+        tbl = CommitteeTable(points)
+        if len(_TABLE_CACHE) >= _TABLE_CACHE_CAP:
+            _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
+        _TABLE_CACHE[key] = tbl
+    return tbl
+
 
 def use_device(flag: bool | None):
     """Force the path (True/False) or restore AUTO (None)."""
@@ -49,6 +115,8 @@ def device_enabled() -> bool:
 
 _VERIFY_BUCKET = 8
 _verify_fn = None
+_agg_verify_fn = None
+_agg_verify_batch_fn = None
 
 
 def _get_verify_fn():
@@ -60,6 +128,116 @@ def _get_verify_fn():
 
         _verify_fn = jax.jit(OB.verify)
     return _verify_fn
+
+
+def _get_agg_verify_fn():
+    global _agg_verify_fn
+    if _agg_verify_fn is None:
+        import jax
+
+        from .ops import bls as OB
+
+        _agg_verify_fn = jax.jit(OB.agg_verify)
+    return _agg_verify_fn
+
+
+def _get_agg_verify_batch_fn():
+    global _agg_verify_batch_fn
+    if _agg_verify_batch_fn is None:
+        import jax
+
+        from .ops import bls as OB
+
+        _agg_verify_batch_fn = jax.jit(OB.agg_verify_batch)
+    return _agg_verify_batch_fn
+
+
+def _fused() -> bool:
+    """One truly-fused jitted agg_verify program on real accelerators.
+    On XLA:CPU every distinct jitted pairing-shaped program costs
+    minutes of LLVM time (see docs/NOTES_r2.md), so the CPU route runs
+    the SAME ops eagerly — op-by-op dispatch reuses small in-process
+    kernel caches, the path the ops suite exercises in seconds.  Same
+    math, same counters, zero big executables."""
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
+                         sig_point) -> bool:
+    """THE fused FBFT quorum check: committee table resident on device,
+    bitmap in, bool out — masked G1 tree-sum AND the 2-pairing product
+    with no host affine round-trip (reference semantics:
+    internal/chain/engine.go:619-642 in one shot)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .ops import interop as I
+    from .ref.hash_to_curve import hash_to_g2
+
+    from .ops import bls as OB
+
+    h = hash_to_g2(payload)
+    COUNTERS["agg_verify"] += 1
+    fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
+    ok = fn(
+        table.device_array(),
+        jnp.asarray(table.pad_bits(bits)),
+        jnp.asarray(I.g2_affine_to_arr(h)),
+        jnp.asarray(I.g2_affine_to_arr(sig_point)),
+    )
+    return bool(np.asarray(ok))
+
+
+# Pinned batch widths for the replay path (same rationale as the
+# committee buckets: a handful of compiled programs covers every batch
+# size).  CPU caps at 64 — XLA:CPU's LLVM JIT struggles with the
+# 256-wide pairing programs on the test image.
+BATCH_BUCKETS_CPU = (8, 64)
+BATCH_BUCKETS_TPU = (8, 64, 256)
+
+
+def batch_buckets() -> tuple:
+    return BATCH_BUCKETS_TPU if device_enabled() else BATCH_BUCKETS_CPU
+
+
+def batch_bucket(n: int) -> int:
+    for b in batch_buckets():
+        if n <= b:
+            return b
+    return batch_buckets()[-1]
+
+
+def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
+                               h_points, sig_points):
+    """Replay-path batch: B quorum checks against one committee table,
+    chunked to pinned batch widths — each chunk is ONE program (masked
+    tree-sums + pairing checks together).  h_points are pre-hashed
+    payload points (host hash-to-G2); returns list[bool]."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .ops import bls as OB
+    from .ops import interop as I
+
+    results = []
+    widest = batch_buckets()[-1]
+    fn = _get_agg_verify_batch_fn() if _fused() else OB.agg_verify_batch
+    tbl = table.device_array()
+    for start in range(0, len(bits_list), widest):
+        chunk_bits = bits_list[start:start + widest]
+        chunk_h = h_points[start:start + widest]
+        chunk_s = sig_points[start:start + widest]
+        n, padded = len(chunk_bits), batch_bucket(len(chunk_bits))
+        sel = list(range(n)) + [0] * (padded - n)  # pad lanes sliced off
+        bm = np.stack([table.pad_bits(chunk_bits[i]) for i in sel])
+        hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
+        sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
+        ok = fn(tbl, jnp.asarray(bm), jnp.asarray(hh), jnp.asarray(sg))
+        COUNTERS["batch_verify"] += 1
+        results.extend(bool(x) for x in np.asarray(ok)[:n])
+    return results
 
 
 def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
@@ -76,12 +254,17 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
     from .ops import interop as I
     from .ref.hash_to_curve import hash_to_g2
 
+    from .ops import bls as OB
+
     h = hash_to_g2(payload)
-    pk = np.asarray(I.g1_batch_affine([pk_point] * _VERIFY_BUCKET))
-    hh = np.asarray(I.g2_batch_affine([h] * _VERIFY_BUCKET))
-    sg = np.asarray(I.g2_batch_affine([sig_point] * _VERIFY_BUCKET))
-    ok = _get_verify_fn()(
-        jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg)
-    )
+    # fused: pad to the pinned bucket so one compiled program serves
+    # every single check; eager (CPU): width 1, no padding — each lane
+    # would re-run the whole pairing op-by-op
+    width = _VERIFY_BUCKET if _fused() else 1
+    pk = np.asarray(I.g1_batch_affine([pk_point] * width))
+    hh = np.asarray(I.g2_batch_affine([h] * width))
+    sg = np.asarray(I.g2_batch_affine([sig_point] * width))
+    fn = _get_verify_fn() if _fused() else OB.verify
+    ok = fn(jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg))
     COUNTERS["verify"] += 1
     return bool(np.asarray(ok)[0])

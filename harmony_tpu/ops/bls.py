@@ -113,6 +113,26 @@ def agg_verify(pk_affs, bitmap, h_aff, agg_sig_aff):
     return verify(pk_aff, h_aff[None], agg_sig_aff[None])[0]
 
 
+def agg_verify_batch(pk_affs, bitmaps, h_affs, agg_sig_affs):
+    """Batched quorum checks against ONE committee table: B headers,
+    each with its own participation bitmap, hashed payload, and
+    aggregate signature — the block-replay throughput shape (reference
+    call stack SURVEY.md §3.3: Engine.VerifyHeaderSignature per block).
+
+    pk_affs: (N, 2, 32) committee pubkeys; bitmaps: (B, N);
+    h_affs / agg_sig_affs: (B, 2, 2, 32).  Returns (B,) bools.
+
+    One compiled program does ALL the masked G1 tree-sums and ALL the
+    pairing checks — no host round-trip between aggregation and verify
+    (the r2 live path paid one per header).
+    """
+    jac = _affine_to_jacobian_g1(pk_affs)  # (N, 3, 32)
+    agg = jax.vmap(lambda bm: CV.masked_sum(jac, bm, CV.FP_OPS))(bitmaps)
+    ax, ay = CV.to_affine(agg, CV.FP_OPS)  # (B, 32) each
+    pk_aff = jnp.stack([ax, ay], axis=-2)  # (B, 2, 32)
+    return verify(pk_aff, h_affs, agg_sig_affs)
+
+
 def aggregate_sigs(sig_affs, bitmap=None):
     """Sign.Add analog: sum signatures (N, 2, 2, 32) in G2, optionally
     bitmap-masked; returns a Jacobian point (3, 2, 32)."""
