@@ -1,0 +1,174 @@
+"""Explorer service: address-indexed chain browsing over HTTP.
+
+The role of the reference's explorer (reference: api/service/explorer —
+a LevelDB-backed index of blocks/txs per address served as JSON over
+HTTP, run by explorer-node configs).  This implementation folds the
+index into the node process: an in-memory address -> [(block, tx_hash,
+direction)] map updated by ``index_through`` (idempotent, resumable by
+height) and a threading HTTP server with the reference's query shapes:
+
+    GET /blocks?from=N&to=M      -> header summaries
+    GET /tx?id=0x..              -> one transaction
+    GET /address?id=0x..         -> balance + tx history
+    GET /height                  -> current indexed height
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class ExplorerIndex:
+    """Address -> transaction-history index (reference: explorer
+    storage.go's address index, minus the disk tier)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.height = 0  # blocks indexed through this number
+        self._by_address: dict[bytes, list] = {}
+        self._tx_index: dict[bytes, tuple] = {}  # hash -> (num, idx)
+        self._lock = threading.Lock()
+
+    def index_through(self, head: int | None = None):
+        head = self.chain.head_number if head is None else head
+        chain_id = self.chain.config.chain_id
+        with self._lock:
+            for num in range(self.height + 1, head + 1):
+                block = self.chain.block_by_number(num)
+                if block is None:
+                    continue
+                for i, tx in enumerate(block.transactions):
+                    h = tx.hash(chain_id)
+                    self._tx_index[h] = (num, i)
+                    sender = tx.sender(chain_id)
+                    self._by_address.setdefault(sender, []).append(
+                        (num, h, "SENT")
+                    )
+                    if tx.to is not None:
+                        self._by_address.setdefault(tx.to, []).append(
+                            (num, h, "RECEIVED")
+                        )
+                self.height = num
+
+    def address_history(self, addr: bytes) -> list:
+        with self._lock:
+            return list(self._by_address.get(addr, ()))
+
+    def tx_location(self, tx_hash: bytes):
+        with self._lock:
+            return self._tx_index.get(tx_hash)
+
+
+class ExplorerServer:
+    """HTTP front-end over the index (reference: explorer service.go
+    GetExplorerBlocks / GetExplorerTransaction / GetExplorerAddress)."""
+
+    def __init__(self, chain, port: int = 0):
+        self.index = ExplorerIndex(chain)
+        self.chain = chain
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                try:
+                    body = outer._route(url.path, q)
+                except (ValueError, KeyError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                if body is None:
+                    self._reply(404, {"error": "not found"})
+                else:
+                    self._reply(200, body)
+
+            def _reply(self, status, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routes -------------------------------------------------------------
+
+    def _header_summary(self, h):
+        return {
+            "number": h.block_num,
+            "hash": "0x" + h.hash().hex(),
+            "parentHash": "0x" + h.parent_hash.hex(),
+            "epoch": h.epoch,
+            "shardID": h.shard_id,
+            "viewID": h.view_id,
+            "timestamp": h.timestamp,
+        }
+
+    def _tx_summary(self, tx, num):
+        chain_id = self.chain.config.chain_id
+        return {
+            "hash": "0x" + tx.hash(chain_id).hex(),
+            "from": "0x" + tx.sender(chain_id).hex(),
+            "to": ("0x" + tx.to.hex()) if tx.to else None,
+            "value": tx.value,
+            "blockNumber": num,
+        }
+
+    def _route(self, path: str, q: dict):
+        self.index.index_through()
+        if path == "/height":
+            return {"height": self.index.height}
+        if path == "/blocks":
+            frm = int(q.get("from", max(self.index.height - 9, 0)))
+            to = int(q.get("to", self.index.height))
+            if to - frm > 256:
+                raise ValueError("range too wide (max 256)")
+            out = []
+            for num in range(frm, to + 1):
+                h = self.chain.header_by_number(num)
+                if h is not None:
+                    out.append(self._header_summary(h))
+            return out
+        if path == "/tx":
+            tx_hash = bytes.fromhex(q["id"][2:])
+            loc = self.index.tx_location(tx_hash)
+            if loc is None:
+                return None
+            num, i = loc
+            block = self.chain.block_by_number(num)
+            return self._tx_summary(block.transactions[i], num)
+        if path == "/address":
+            addr = bytes.fromhex(q["id"][2:])
+            history = []
+            for num, h, direction in self.index.address_history(addr):
+                history.append({
+                    "hash": "0x" + h.hex(), "blockNumber": num,
+                    "type": direction,
+                })
+            return {
+                "id": q["id"],
+                "balance": self.chain.state().balance(addr),
+                "txCount": len(history),
+                "txs": history,
+            }
+        return None

@@ -74,6 +74,91 @@ class Harmony:
                     return num, i, tx
         return None
 
+    def get_receipt(self, tx_hash: bytes):
+        """(block_num, index, receipt) or None (reference:
+        rpc GetTransactionReceipt over the rawdb tx-hash index)."""
+        from ..core import rawdb
+
+        num = rawdb.read_receipt_block_num(self.chain.db, tx_hash)
+        if num is None:
+            return None
+        for i, rc in enumerate(rawdb.read_receipts(self.chain.db, num)):
+            if rc.tx_hash == tx_hash:
+                return num, i, rc
+        return None
+
+    def get_logs(self, from_block: int, to_block: int,
+                 address: bytes | None = None,
+                 topics: list | None = None) -> list:
+        """Matching logs as (block_num, tx_hash, log_index, addr,
+        topics, data) tuples (reference: eth filters GetLogs)."""
+        from ..core import rawdb
+
+        out = []
+        to_block = min(to_block, self.chain.head_number)
+        for num in range(max(from_block, 1), to_block + 1):
+            idx = 0
+            for rc in rawdb.read_receipts(self.chain.db, num):
+                for addr, tps, data in rc.logs:
+                    match = address is None or addr == address
+                    if match and topics:
+                        for want, got in zip(topics, tps):
+                            if want is not None and want != got:
+                                match = False
+                                break
+                        if len(topics) > len(tps):
+                            match = False
+                    if match:
+                        out.append((num, rc.tx_hash, idx, addr, tps, data))
+                    idx += 1
+        return out
+
+    def get_code(self, address: bytes) -> bytes:
+        return self.chain.state().code(address)
+
+    def get_storage_at(self, address: bytes, slot: bytes) -> int:
+        return self.chain.state().storage_get(address, slot)
+
+    def call(self, frm: bytes, to: bytes | None, value: int,
+             data: bytes, gas: int, trace: bool = False):
+        """Read-only EVM simulation against the head state (reference:
+        rpc Call / DoEVMCall).  Returns (ok, gas_left, output, tracer)."""
+        from ..core.vm import EVM, CallTracer, Env
+
+        state = self.chain.state().copy()
+        env = Env(
+            block_num=self.chain.head_number,
+            chain_id=self.chain.config.chain_id,
+            epoch=self.current_epoch(),
+            shard_id=self.chain.shard_id,
+        )
+        tracer = CallTracer() if trace else None
+        evm = EVM(state, env, origin=frm, gas_price=1, tracer=tracer)
+        if to is None:
+            ok, gas_left, out = evm.create(frm, value, data, gas)
+        else:
+            ok, gas_left, out = evm.call(frm, to, value, data, gas)
+        return ok, gas_left, out, tracer
+
+    def estimate_gas(self, frm: bytes, to: bytes | None, value: int,
+                     data: bytes) -> int:
+        """Binary-search the minimum sufficient gas (reference:
+        rpc EstimateGas shape, simplified to one upper-bound probe +
+        bisection)."""
+        hi = 10_000_000
+        ok, gas_left, _, _ = self.call(frm, to, value, data, hi)
+        if not ok:
+            raise ValueError("execution reverts at gas cap")
+        lo, best = 21000, hi
+        while lo <= best:
+            mid = (lo + best) // 2
+            ok, _, _, _ = self.call(frm, to, value, data, mid)
+            if ok:
+                best = mid - 1
+            else:
+                lo = mid + 1
+        return lo
+
     # -- staking reads ------------------------------------------------------
 
     def validator_addresses(self) -> list:

@@ -218,5 +218,8 @@ class MetricsServer:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        # shutdown() BLOCKS FOREVER if serve_forever never ran — guard
+        # so stopping a constructed-but-never-started server is a no-op
+        if self._thread.is_alive():
+            self._httpd.shutdown()
         self._httpd.server_close()

@@ -1,0 +1,209 @@
+"""Rosetta Data API: the Coinbase-spec chain-access surface.
+
+The role of the reference's rosetta/ package (reference:
+rosetta/rosetta.go + rosetta/services — NetworkAPI/BlockAPI/AccountAPI
+controllers over the hmy facade).  This serves the Data API subset a
+Rosetta integrator reads first, as POST JSON endpoints:
+
+    /network/list     -> the one (shard) network identifier
+    /network/status   -> genesis + current block identifiers
+    /network/options  -> version + operation vocabulary
+    /block            -> block + transfer operations
+    /account/balance  -> balance at the head block
+
+Operation vocabulary mirrors the reference's rosetta operation types
+(NativeTransfer / Gas — rosetta/common/operations.go); construction
+endpoints (signing flows) are out of scope here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ROSETTA_VERSION = "1.4.10"
+BLOCKCHAIN = "Harmony"
+
+
+class RosettaServer:
+    def __init__(self, hmy, port: int = 0):
+        self.hmy = hmy
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                try:
+                    ln = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(ln) or b"{}")
+                except ValueError:
+                    self._reply(500, {"code": 1, "message": "parse error"})
+                    return
+                fn = {
+                    "/network/list": outer._network_list,
+                    "/network/status": outer._network_status,
+                    "/network/options": outer._network_options,
+                    "/block": outer._block,
+                    "/account/balance": outer._account_balance,
+                }.get(self.path)
+                if fn is None:
+                    self._reply(404, {"code": 2, "message": "no route"})
+                    return
+                try:
+                    self._reply(200, fn(req))
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(
+                        500, {"code": 3, "message": str(e),
+                              "retriable": False},
+                    )
+
+            def _reply(self, status, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- identifiers --------------------------------------------------------
+
+    def _net_id(self):
+        return {
+            "blockchain": BLOCKCHAIN,
+            "network": f"shard-{self.hmy.shard_id()}",
+        }
+
+    def _block_id(self, num: int):
+        h = self.hmy.header_by_number(num)
+        return {
+            "index": num,
+            "hash": "0x" + (h.hash().hex() if h else "00" * 32),
+        }
+
+    # -- endpoints ----------------------------------------------------------
+
+    def _network_list(self, req):
+        return {"network_identifiers": [self._net_id()]}
+
+    def _network_status(self, req):
+        head = self.hmy.block_number()
+        return {
+            "current_block_identifier": self._block_id(head),
+            "genesis_block_identifier": self._block_id(0),
+            "current_block_timestamp": (
+                (self.hmy.header_by_number(head).timestamp or 1) * 1000
+            ),
+            "peers": [],
+        }
+
+    def _network_options(self, req):
+        return {
+            "version": {
+                "rosetta_version": ROSETTA_VERSION,
+                "node_version": "harmony-tpu/0.1",
+            },
+            "allow": {
+                "operation_statuses": [
+                    {"status": "success", "successful": True},
+                    {"status": "failure", "successful": False},
+                ],
+                "operation_types": ["NativeTransfer", "Gas"],
+                "errors": [
+                    {"code": 1, "message": "parse error"},
+                    {"code": 2, "message": "no route"},
+                    {"code": 3, "message": "internal"},
+                ],
+            },
+        }
+
+    def _currency(self):
+        return {"symbol": "ONE", "decimals": 18}
+
+    def _block(self, req):
+        ident = req.get("block_identifier", {})
+        num = ident.get("index")
+        if num is None and ident.get("hash"):
+            blk = self.hmy.block_by_hash(bytes.fromhex(ident["hash"][2:]))
+            num = blk.block_num if blk else self.hmy.block_number()
+        if num is None:
+            num = self.hmy.block_number()
+        block = self.hmy.block_by_number(num)
+        if block is None:
+            raise ValueError(f"no block {num}")
+        chain_id = self.hmy.chain_id()
+        txs = []
+        for tx in block.transactions:
+            sender = tx.sender(chain_id)
+            ops = [
+                {
+                    "operation_identifier": {"index": 0},
+                    "type": "NativeTransfer",
+                    "status": "success",
+                    "account": {"address": "0x" + sender.hex()},
+                    "amount": {
+                        "value": str(-tx.value),
+                        "currency": self._currency(),
+                    },
+                },
+            ]
+            if tx.to is not None:
+                ops.append({
+                    "operation_identifier": {"index": 1},
+                    "related_operations": [{"index": 0}],
+                    "type": "NativeTransfer",
+                    "status": "success",
+                    "account": {"address": "0x" + tx.to.hex()},
+                    "amount": {
+                        "value": str(tx.value),
+                        "currency": self._currency(),
+                    },
+                })
+            txs.append({
+                "transaction_identifier": {
+                    "hash": "0x" + tx.hash(chain_id).hex()
+                },
+                "operations": ops,
+            })
+        h = block.header
+        return {
+            "block": {
+                "block_identifier": self._block_id(num),
+                "parent_block_identifier": self._block_id(
+                    max(num - 1, 0)
+                ),
+                "timestamp": (h.timestamp or 1) * 1000,
+                "transactions": txs,
+            }
+        }
+
+    def _account_balance(self, req):
+        addr_hex = req["account_identifier"]["address"]
+        addr = bytes.fromhex(
+            addr_hex[2:] if addr_hex.startswith("0x") else addr_hex
+        )
+        head = self.hmy.block_number()
+        return {
+            "block_identifier": self._block_id(head),
+            "balances": [{
+                "value": str(self.hmy.get_balance(addr)),
+                "currency": self._currency(),
+            }],
+        }

@@ -71,12 +71,54 @@ class RateLimiter:
             return True
 
 
+class _Filters:
+    """Installed eth filters (reference: eth/filters — polling model:
+    newFilter / getFilterChanges / uninstallFilter)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 1
+        self._filters: dict = {}  # id -> {"kind", "last_block", criteria}
+
+    def install(self, kind: str, criteria: dict | None = None,
+                head: int = 0) -> int:
+        with self._lock:
+            fid = self._next
+            self._next += 1
+            self._filters[fid] = {
+                "kind": kind, "last_block": head,
+                "criteria": criteria or {},
+            }
+            return fid
+
+    def get(self, fid: int):
+        with self._lock:
+            return self._filters.get(fid)
+
+    def take_range(self, fid: int, head: int):
+        """Atomically advance the filter's cursor to ``head`` and
+        return (kind, criteria, since) — concurrent polls under the
+        ThreadingHTTPServer must not double- or under-report."""
+        with self._lock:
+            f = self._filters.get(fid)
+            if f is None:
+                return None
+            since = f["last_block"]
+            f["last_block"] = head
+            return f["kind"], dict(f["criteria"]), since
+
+    def uninstall(self, fid: int) -> bool:
+        with self._lock:
+            return self._filters.pop(fid, None) is not None
+
+
 class RPCServer:
     def __init__(self, hmy, port: int = 0, method_allowlist=None,
                  rate_limiter: RateLimiter | None = None):
         self.hmy = hmy
         self.allow = set(method_allowlist) if method_allowlist else None
         self.limiter = rate_limiter or RateLimiter()
+        self.filters = _Filters()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -122,7 +164,10 @@ class RPCServer:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        # shutdown() BLOCKS FOREVER if serve_forever never ran — guard
+        # so stopping a constructed-but-never-started server is a no-op
+        if self._thread.is_alive():
+            self._httpd.shutdown()
         self._httpd.server_close()
 
     # -- dispatch -----------------------------------------------------------
@@ -299,3 +344,186 @@ class RPCServer:
         committee = self.hmy.committee(epoch)
         signed, _ = block_signers(proof[96:], committee)
         return ["0x" + k.hex() for k in signed]
+
+    # -- receipts / logs / filters (reference: rpc transaction.go
+    # GetTransactionReceipt + eth/filters polling API) -----------------
+
+    def _log_dict(self, num, tx_hash, idx, addr, topics, data, v2):
+        return {
+            "address": "0x" + addr.hex(),
+            "topics": ["0x" + t.hex() for t in topics],
+            "data": "0x" + data.hex(),
+            "blockNumber": self._int(num, v2),
+            "transactionHash": "0x" + tx_hash.hex(),
+            "logIndex": self._int(idx, v2),
+        }
+
+    def _getTransactionReceipt(self, params, v2):
+        found = self.hmy.get_receipt(bytes.fromhex(params[0][2:]))
+        if found is None:
+            return None
+        num, idx, rc = found
+        out = {
+            "transactionHash": "0x" + rc.tx_hash.hex(),
+            "blockNumber": self._int(num, v2),
+            "transactionIndex": self._int(idx, v2),
+            "status": self._int(rc.status, v2),
+            "gasUsed": self._int(rc.gas_used, v2),
+            "cumulativeGasUsed": self._int(rc.cumulative_gas, v2),
+            "logs": [
+                self._log_dict(num, rc.tx_hash, i, a, t, d, v2)
+                for i, (a, t, d) in enumerate(rc.logs)
+            ],
+            "contractAddress": (
+                "0x" + rc.contract_address.hex()
+                if rc.contract_address else None
+            ),
+        }
+        return out
+
+    def _parse_log_criteria(self, crit):
+        head = self.hmy.block_number()
+        frm = _block_num(crit.get("fromBlock", "latest"), head)
+        to = _block_num(crit.get("toBlock", "latest"), head)
+        address = _addr(crit["address"]) if crit.get("address") else None
+        topics = None
+        if crit.get("topics"):
+            topics = [
+                bytes.fromhex(t[2:]) if isinstance(t, str) else None
+                for t in crit["topics"]
+            ]
+        return frm, to, address, topics
+
+    def _getLogs(self, params, v2):
+        frm, to, address, topics = self._parse_log_criteria(
+            params[0] if params else {}
+        )
+        return [
+            self._log_dict(*entry, v2)
+            for entry in self.hmy.get_logs(frm, to, address, topics)
+        ]
+
+    def _newFilter(self, params, v2):
+        fid = self.filters.install(
+            "logs", params[0] if params else {}, self.hmy.block_number()
+        )
+        return self._int(fid, v2)
+
+    def _newBlockFilter(self, params, v2):
+        return self._int(
+            self.filters.install("blocks", head=self.hmy.block_number()), v2
+        )
+
+    def _newPendingTransactionFilter(self, params, v2):
+        return self._int(
+            self.filters.install("pending", head=self.hmy.block_number()),
+            v2,
+        )
+
+    def _getFilterChanges(self, params, v2):
+        fid = int(params[0], 16) if isinstance(params[0], str) else params[0]
+        head = self.hmy.block_number()
+        taken = self.filters.take_range(fid, head)
+        if taken is None:
+            raise ValueError("filter not found")
+        kind, criteria, since = taken
+        f = {"kind": kind, "criteria": criteria}
+        if f["kind"] == "blocks":
+            out = []
+            for n in range(since + 1, head + 1):
+                h = self.hmy.header_by_number(n)
+                if h is not None:
+                    out.append("0x" + h.hash().hex())
+            return out
+        if f["kind"] == "pending":
+            return []  # pending pool surface: poll blocks instead
+        crit = dict(f["criteria"])
+        crit.setdefault("fromBlock", since + 1)
+        crit.setdefault("toBlock", head)
+        frm, to, address, topics = self._parse_log_criteria(crit)
+        return [
+            self._log_dict(*e, v2)
+            for e in self.hmy.get_logs(max(frm, since + 1), to,
+                                       address, topics)
+        ]
+
+    def _getFilterLogs(self, params, v2):
+        fid = int(params[0], 16) if isinstance(params[0], str) else params[0]
+        f = self.filters.get(fid)
+        if f is None or f["kind"] != "logs":
+            raise ValueError("filter not found")
+        frm, to, address, topics = self._parse_log_criteria(f["criteria"])
+        return [
+            self._log_dict(*e, v2)
+            for e in self.hmy.get_logs(frm, to, address, topics)
+        ]
+
+    def _uninstallFilter(self, params, v2):
+        fid = int(params[0], 16) if isinstance(params[0], str) else params[0]
+        return self.filters.uninstall(fid)
+
+    # -- EVM reads (reference: rpc contract.go Call/EstimateGas/GetCode) ---
+
+    def _call_args(self, obj):
+        frm = _addr(obj["from"]) if obj.get("from") else b"\x00" * 20
+        to = _addr(obj["to"]) if obj.get("to") else None
+        value = int(obj.get("value", "0x0"), 16) if isinstance(
+            obj.get("value", 0), str) else int(obj.get("value", 0))
+        data_hex = obj.get("data", obj.get("input", "0x")) or "0x"
+        data = bytes.fromhex(data_hex[2:])
+        gas = int(obj.get("gas", "0x989680"), 16) if isinstance(
+            obj.get("gas", 0), str) else int(obj.get("gas") or 10_000_000)
+        return frm, to, value, data, gas
+
+    def _call(self, params, v2):
+        frm, to, value, data, gas = self._call_args(params[0])
+        ok, _gas_left, out, _ = self.hmy.call(frm, to, value, data, gas)
+        if not ok:
+            raise ValueError("execution reverted: 0x" + out.hex())
+        return "0x" + out.hex()
+
+    def _estimateGas(self, params, v2):
+        frm, to, value, data, _ = self._call_args(params[0])
+        return self._int(self.hmy.estimate_gas(frm, to, value, data), v2)
+
+    def _getCode(self, params, v2):
+        return "0x" + self.hmy.get_code(_addr(params[0])).hex()
+
+    def _getStorageAt(self, params, v2):
+        slot_param = params[1]
+        slot_int = int(slot_param, 16) if isinstance(slot_param, str) \
+            else int(slot_param)
+        v = self.hmy.get_storage_at(
+            _addr(params[0]), slot_int.to_bytes(32, "big")
+        )
+        return "0x" + v.to_bytes(32, "big").hex()
+
+    def _gasPrice(self, params, v2):
+        return self._int(1_000_000_000, v2)  # min gas price placeholder
+
+    # -- debug namespace (reference: eth/tracers callTracer) ---------------
+
+    def _traceTransaction(self, params, v2):
+        """Re-execute a mined transaction with the CallTracer against
+        its parent state (reference: debug_traceTransaction)."""
+        tx_hash = bytes.fromhex(params[0][2:])
+        found = self.hmy.get_transaction(tx_hash)
+        if found is None:
+            return None
+        num, _idx, tx = found
+        from ..core.vm import EVM, CallTracer, Env
+
+        state = self.hmy.chain.state_at(num - 1).copy()
+        chain_id = self.hmy.chain_id()
+        sender = tx.sender(chain_id)
+        env = Env(block_num=num, chain_id=chain_id,
+                  shard_id=self.hmy.shard_id())
+        tracer = CallTracer()
+        evm = EVM(state, env, origin=sender, gas_price=tx.gas_price,
+                  tracer=tracer)
+        state.set_nonce(sender, tx.nonce + 1)
+        if tx.to is None:
+            evm.create(sender, tx.value, tx.data, tx.gas_limit)
+        else:
+            evm.call(sender, tx.to, tx.value, tx.data, tx.gas_limit)
+        return tracer.root

@@ -1,0 +1,107 @@
+"""Explorer index/HTTP service + Rosetta Data API (reference:
+api/service/explorer, rosetta/ — VERDICT r2 missing #8)."""
+
+import http.client
+import json
+
+import pytest
+
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.types import Transaction
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.explorer import ExplorerServer
+from harmony_tpu.hmy.facade import Harmony
+from harmony_tpu.node.worker import Worker
+from harmony_tpu.rosetta import RosettaServer
+
+CHAIN_ID = 2
+
+
+@pytest.fixture(scope="module")
+def stack():
+    genesis, keys, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    worker = Worker(chain, pool)
+    to = b"\x0b" * 20
+    tx = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=0,
+        to=to, value=4242,
+    ).sign(keys[0], CHAIN_ID)
+    pool.add(tx)
+    block = worker.propose_block(view_id=1)
+    chain.insert_chain([block], verify_seals=False)
+    pool.drop_applied()
+    return chain, keys, to, tx
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read()))
+    conn.close()
+    return out
+
+
+def _post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read()))
+    conn.close()
+    return out
+
+
+def test_explorer_blocks_tx_address(stack):
+    chain, keys, to, tx = stack
+    ex = ExplorerServer(chain).start()
+    try:
+        status, height = _get(ex.port, "/height")
+        assert status == 200 and height["height"] == 1
+        status, blocks = _get(ex.port, "/blocks?from=0&to=1")
+        assert [b["number"] for b in blocks] == [0, 1]
+        txh = "0x" + tx.hash(CHAIN_ID).hex()
+        status, got = _get(ex.port, f"/tx?id={txh}")
+        assert got["value"] == 4242 and got["blockNumber"] == 1
+        sender_hex = "0x" + keys[0].address().hex()
+        status, addr = _get(ex.port, f"/address?id={sender_hex}")
+        assert addr["txCount"] == 1
+        assert addr["txs"][0]["type"] == "SENT"
+        status, recv = _get(ex.port, "/address?id=0x" + to.hex())
+        assert recv["balance"] == 4242
+        assert recv["txs"][0]["type"] == "RECEIVED"
+        status, _ = _get(ex.port, "/tx?id=0x" + "00" * 32)
+        assert status == 404
+    finally:
+        ex.stop()
+
+
+def test_rosetta_data_api(stack):
+    chain, keys, to, tx = stack
+    rs = RosettaServer(Harmony(chain)).start()
+    try:
+        status, nets = _post(rs.port, "/network/list", {})
+        assert nets["network_identifiers"][0]["network"] == "shard-0"
+        status, st = _post(rs.port, "/network/status", {})
+        assert st["current_block_identifier"]["index"] == 1
+        assert st["genesis_block_identifier"]["index"] == 0
+        status, opts = _post(rs.port, "/network/options", {})
+        assert "NativeTransfer" in opts["allow"]["operation_types"]
+        status, blk = _post(rs.port, "/block",
+                            {"block_identifier": {"index": 1}})
+        ops = blk["block"]["transactions"][0]["operations"]
+        assert ops[0]["amount"]["value"] == "-4242"
+        assert ops[1]["amount"]["value"] == "4242"
+        assert ops[1]["account"]["address"] == "0x" + to.hex()
+        status, bal = _post(rs.port, "/account/balance", {
+            "account_identifier": {"address": "0x" + to.hex()},
+        })
+        assert bal["balances"][0]["value"] == "4242"
+        status, err = _post(rs.port, "/nope", {})
+        assert status == 404
+    finally:
+        rs.stop()

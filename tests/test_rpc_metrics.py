@@ -150,3 +150,91 @@ def test_metrics_registry_and_server():
         assert "chain_head 42" in body
     finally:
         srv.stop()
+
+
+def test_rpc_receipt_logs_filters_and_call(stack):
+    """The round-3 RPC surface: receipts, getLogs, polling filters,
+    eth_call/estimateGas, code/storage, debug_traceTransaction
+    (reference: rpc transaction.go/contract.go + eth/filters)."""
+    srv, hmy, keys, to, tx = stack
+    chain = hmy.chain
+    worker = Worker(chain, hmy.tx_pool)
+    if len(hmy.tx_pool):  # flush txs parked by earlier tests
+        block = worker.propose_block(view_id=chain.head_number + 1)
+        chain.insert_chain([block], verify_seals=False)
+        hmy.tx_pool.drop_applied()
+    txh = "0x" + tx.hash(CHAIN_ID).hex()
+
+    # receipt for the mined transfer (indexed lookup)
+    rc = _call(srv.port, "eth_getTransactionReceipt", [txh])["result"]
+    assert rc["status"] == "0x1" and rc["blockNumber"] == "0x1"
+    assert rc["logs"] == []
+    assert _call(srv.port, "eth_getTransactionReceipt",
+                 ["0x" + "ab" * 32])["result"] is None
+
+    # deploy a log-emitting contract through the processor
+    # runtime: log1(0, 0, topic=0x77); stop
+    runtime = bytes([0x60, 0x77, 0x60, 0x00, 0x60, 0x00, 0xA1, 0x00])
+    init = bytes([
+        0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+        0x60, len(runtime), 0x60, 0x00, 0xF3,
+    ]) + runtime
+    sender_nonce = chain.state().nonce(keys[0].address())
+    deploy = Transaction(
+        nonce=sender_nonce, gas_price=1, gas_limit=500_000, shard_id=0,
+        to_shard=0, to=None, value=0, data=init,
+    ).sign(keys[0], CHAIN_ID)
+    hmy.tx_pool.add(deploy)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+    drc = _call(
+        srv.port, "eth_getTransactionReceipt",
+        ["0x" + deploy.hash(CHAIN_ID).hex()],
+    )["result"]
+    ca = drc["contractAddress"]
+    assert ca is not None
+
+    # call the contract: the log shows in the receipt AND eth_getLogs
+    invoke = Transaction(
+        nonce=chain.state().nonce(keys[0].address()), gas_price=1,
+        gas_limit=200_000, shard_id=0, to_shard=0,
+        to=bytes.fromhex(ca[2:]), value=0, data=b"",
+    ).sign(keys[0], CHAIN_ID)
+    hmy.tx_pool.add(invoke)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+    topic = "0x" + (0x77).to_bytes(32, "big").hex()
+    logs = _call(srv.port, "eth_getLogs", [{
+        "fromBlock": "0x1", "toBlock": "latest", "address": ca,
+    }])["result"]
+    assert len(logs) == 1 and logs[0]["topics"] == [topic]
+
+    # polling filter sees only NEW blocks
+    fid = _call(srv.port, "eth_newBlockFilter")["result"]
+    assert _call(srv.port, "eth_getFilterChanges", [fid])["result"] == []
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    changes = _call(srv.port, "eth_getFilterChanges", [fid])["result"]
+    assert changes == ["0x" + block.hash().hex()]
+    assert _call(srv.port, "eth_uninstallFilter", [fid])["result"] is True
+
+    # eth_call reads state without mutating it; estimateGas bounds it
+    out = _call(srv.port, "eth_call", [{
+        "from": "0x" + keys[0].address().hex(), "to": ca, "data": "0x",
+    }])["result"]
+    assert out == "0x"
+    est = _call(srv.port, "eth_estimateGas", [{
+        "from": "0x" + keys[0].address().hex(), "to": ca,
+    }])["result"]
+    assert 21000 <= int(est, 16) < 60_000
+
+    # code/storage reads + call tracer
+    code = _call(srv.port, "eth_getCode", [ca])["result"]
+    assert code == "0x" + runtime.hex()
+    trace = _call(
+        srv.port, "debug_traceTransaction",
+        ["0x" + invoke.hash(CHAIN_ID).hex()],
+    )["result"]
+    assert trace["type"] == "CALL" and trace["to"] == ca[2:].lower()

