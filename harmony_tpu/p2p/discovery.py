@@ -1,0 +1,124 @@
+"""Peer discovery: PEX maintenance loop + bootnode entry point.
+
+The reference's discovery service wraps libp2p's Kademlia DHT —
+Advertise() announces the node under its shard topic and FindPeers()
+streams candidates back (reference: p2p/discovery/discovery.go:41-79),
+with bootnodes as the DHT's entry points (cmd/bootnode/main.go).  This
+transport keeps the same contract with an explicit peer-exchange
+protocol on the TCP flood host (p2p/host.py ADVERT/PEERS_REQ frames):
+
+* every connection ADVERTs its dialable address;
+* ``Discovery`` periodically pulls peer lists (PEX) and dials unknown
+  addresses until ``target_peers`` connections are live;
+* a bootnode is just a Discovery-running host with no consensus stack —
+  it learns every ADVERT and answers every PEERS_REQ, seeding the mesh.
+
+All dials go through the host's Gater (p2p/gating.py), so banned /
+rate-limited addresses stay unreachable exactly as for inbound peers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..log import get_logger
+from .host import TCPHost
+
+_log = get_logger("discovery")
+
+
+class Discovery:
+    """PEX maintenance loop for one host."""
+
+    def __init__(self, host: TCPHost, bootnodes: list | None = None,
+                 target_peers: int = 8, interval: float = 2.0):
+        self.host = host
+        self.bootnodes = list(bootnodes or [])
+        self.target_peers = target_peers
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.dials = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Discovery":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    # -- the loop -----------------------------------------------------------
+
+    def _my_addr(self) -> str:
+        return f"127.0.0.1:{self.host.port}"
+
+    def _dial(self, addr: str) -> bool:
+        host_part, _, port_part = addr.rpartition(":")
+        try:
+            self.host.connect(int(port_part), host_part or "127.0.0.1")
+            self.dials += 1
+            return True
+        except (OSError, ValueError, ConnectionError):
+            return False
+
+    def step(self):
+        """One maintenance round (callable directly from tests)."""
+        if self.host.peer_count() == 0 and self.bootnodes:
+            for b in self.bootnodes:
+                self._dial(b)
+        if self.host.peer_count() >= self.target_peers:
+            return
+        # pull fresh addresses, then dial the ones we are not holding a
+        # connection to (self excluded)
+        self.host.request_peers()
+        connected = self.host.connected_addrs()
+        me = self._my_addr()
+        for addr in list(self.host.known_addrs):
+            if self.host.peer_count() >= self.target_peers:
+                break
+            if addr == me or addr in connected or addr in self.bootnodes:
+                continue
+            if self._dial(addr):
+                _log.info("pex dial", me=me, peer=addr)
+                # one dial per step per address; connection handshake
+                # (HELLO+ADVERT) lands asynchronously
+                connected.add(addr)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — keep discovering
+                _log.warn("discovery step failed", err=str(e))
+            self._stop.wait(self.interval)
+
+
+def run_bootnode(port: int = 9876, name: str = "bootnode") -> TCPHost:
+    """The bootnode entry point (reference: cmd/bootnode/main.go): a
+    bare host whose only job is to accumulate ADVERTs and answer
+    PEERS_REQs.  Returns the listening host."""
+    host = TCPHost(name=name, listen_port=port)
+    _log.info("bootnode listening", port=host.port)
+    return host
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="harmony-tpu bootnode")
+    p.add_argument("--port", type=int, default=9876)
+    args = p.parse_args(argv)
+    host = run_bootnode(args.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        host.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,13 @@ MAX_MESSAGE_BYTES = 2 * 1024 * 1024  # reference: p2p/host.go:98-99
 _FRAME = struct.Struct("<IB")
 _KIND_PUBLISH = 1
 _KIND_HELLO = 2
+# peer exchange (the reference's discovery rides libp2p's DHT —
+# p2p/discovery/discovery.go:41-79 Advertise/FindPeers; this transport
+# carries the same contract as explicit frames: each peer ADVERTs its
+# dialable address, and PEERS_REQ/RESP gossip known addresses around)
+_KIND_ADVERT = 3      # payload: "ip:port" this peer is dialable at
+_KIND_PEERS_REQ = 4   # payload: empty
+_KIND_PEERS_RESP = 5  # payload: "\n"-joined "ip:port" list
 
 # validator verdicts (gossipsub semantics)
 ACCEPT = 0
@@ -173,6 +180,10 @@ class TCPHost(Host):
         self._peers: dict[object, str] = {}  # socket -> peer name
         self._peer_lock = threading.Lock()
         self._closing = False
+        # peer-exchange state: addresses this host knows to be dialable
+        # (its own + those ADVERTed by / learned from peers)
+        self.known_addrs: dict[str, float] = {}  # "ip:port" -> learned-at
+        self._peer_addr: dict[object, str] = {}  # socket -> advertised
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", listen_port))
@@ -233,6 +244,10 @@ class TCPHost(Host):
             _log.info(
                 "peer connected", me=self.name, peer=peer_name, ip=ip
             )
+            # advertise our own dialable address for peer exchange
+            self._send_frame(
+                sock, _KIND_ADVERT, f"127.0.0.1:{self.port}".encode()
+            )
             while not self._closing:
                 hdr = self._recv_exact(sock, _FRAME.size)
                 if hdr is None:
@@ -245,11 +260,30 @@ class TCPHost(Host):
                     return
                 if kind == _KIND_PUBLISH:
                     self._on_publish(body, sock, peer_name)
+                elif kind == _KIND_ADVERT and ln <= 64:
+                    addr = body.decode(errors="replace")
+                    with self._peer_lock:
+                        self._peer_addr[sock] = addr
+                        self._remember_addr(addr, time.monotonic())
+                elif kind == _KIND_PEERS_REQ:
+                    with self._peer_lock:
+                        addrs = list(self.known_addrs)[:32]
+                    addrs.append(f"127.0.0.1:{self.port}")
+                    self._send_frame(
+                        sock, _KIND_PEERS_RESP, "\n".join(addrs).encode()
+                    )
+                elif kind == _KIND_PEERS_RESP and ln <= 4096:
+                    now = time.monotonic()
+                    with self._peer_lock:
+                        for addr in body.decode(errors="replace").split("\n"):
+                            if addr and addr.count(":") == 1:
+                                self._remember_addr(addr, now)
         except OSError:
             pass
         finally:
             with self._peer_lock:
                 dropped = self._peers.pop(sock, None)
+                self._peer_addr.pop(sock, None)
             if dropped is not None and not self._closing:
                 _log.info("peer disconnected", me=self.name, peer=dropped)
             self.gater.release(ip)
@@ -294,6 +328,34 @@ class TCPHost(Host):
         body = self._pack_publish(topic, payload)
         self._seen.seen(keccak256(body))  # don't re-deliver to self
         self._flood(body)
+
+    _KNOWN_ADDRS_CAP = 256
+
+    def _remember_addr(self, addr: str, now: float):
+        """Bounded peer-address store (caller holds _peer_lock): a
+        hostile peer flooding fabricated addresses must not grow
+        memory — oldest entries rotate out."""
+        if addr in self.known_addrs:
+            return
+        while len(self.known_addrs) >= self._KNOWN_ADDRS_CAP:
+            self.known_addrs.pop(next(iter(self.known_addrs)))
+        self.known_addrs[addr] = now
+
+    def request_peers(self):
+        """Ask every connected peer for its known addresses (PEX pull).
+        Responses land asynchronously in ``known_addrs``."""
+        with self._peer_lock:
+            socks = list(self._peers)
+        for s in socks:
+            try:
+                self._send_frame(s, _KIND_PEERS_REQ, b"")
+            except OSError:
+                pass
+
+    def connected_addrs(self) -> set:
+        """Advertised addresses of currently-connected peers."""
+        with self._peer_lock:
+            return set(self._peer_addr.values())
 
     def peer_count(self) -> int:
         with self._peer_lock:
