@@ -47,7 +47,8 @@ DEFAULTS = {
     "metrics_port": 9900,
     "p2p_port": 9000,
     "sync_port": 9001,
-    "peers": [],          # "host:port" gossip peers
+    "peers": [],          # "host:port" gossip peers (static)
+    "bootnodes": [],      # "host:port" bootnodes for PEX discovery
     "sync_peers": [],     # "host:port" sync stream servers
     "bls_keys": [],       # [{"path": ..., "passphrase_file": ...}]
     "in_memory": False,
@@ -59,6 +60,16 @@ DEFAULTS = {
     # seal verification in the live node (reference nodes always
     # verify; False only for throwaway dev chains)
     "verify_seals": True,
+    # quorum-check backend: "in-process" (default) runs the TPU/host
+    # paths in this process; "sidecar" ships checks to the
+    # verification sidecar at sidecar_addr (SURVEY §7.3; served by
+    # harmony_tpu.sidecar.server / native/sidecar_client.cpp)
+    "verify_backend": "in-process",
+    "sidecar_addr": "127.0.0.1:9600",
+    # optional HTTP services (None = disabled; 0 = ephemeral port)
+    "explorer_port": None,
+    "rosetta_port": None,
+    "ws_port": None,  # WebSocket JSON-RPC + eth_subscribe push
 }
 
 
@@ -116,6 +127,7 @@ def build_node(cfg: dict):
     # the node refuses unsigned chains unless verify_seals=False).
     # Late-bound committee provider: reads the chain wired just below.
     chain_cell: list = []
+    epoch_chain_cell: list = []
 
     def _committee_provider(shard_id: int, epoch: int) -> EpochContext:
         chain_ = chain_cell[0]
@@ -127,21 +139,55 @@ def build_node(cfg: dict):
             com = state.find_committee(shard_id) if state else None
             if com is not None and com.slots:
                 keys = com.bls_pubkeys()
+            elif epoch_chain_cell:
+                # foreign shard: resolve through the beacon epoch light
+                # chain (core/epochchain.py — the reference's
+                # EpochChain); [] when it hasn't seen the epoch
+                keys = epoch_chain_cell[0].committee_for(shard_id, epoch)
             else:
-                keys = list(chain_.genesis.committee)
+                # no resolvable committee for a FOREIGN shard: fail
+                # closed with an empty context (rejects every proof) —
+                # falling back to the local genesis committee would
+                # verify cross-shard seals against the wrong key set
+                # and accept headers sealed by the local keys
+                keys = []
         return EpochContext(keys)
 
     if cfg.get("device_verify") is not None:
         from . import device as DV
 
         DV.use_device(cfg["device_verify"])
+    backend = None
+    if cfg.get("verify_backend") == "sidecar":
+        from .sidecar.client import SidecarClient
+
+        addr = cfg.get("sidecar_addr", "127.0.0.1:9600")
+        if ":" in addr:  # host:port, else a unix socket path
+            host_part, _, port_part = addr.rpartition(":")
+            backend = SidecarClient(
+                (host_part or "127.0.0.1", int(port_part))
+            )
+        else:
+            backend = SidecarClient(addr)
     engine = (
-        Engine(_committee_provider) if cfg.get("verify_seals", True)
-        else None
+        Engine(_committee_provider, backend=backend)
+        if cfg.get("verify_seals", True) else None
     )
     chain = Blockchain(db, genesis, engine=engine,
                        blocks_per_epoch=cfg["blocks_per_epoch"])
     chain_cell.append(chain)
+    if cfg["shard_id"] != 0:
+        # non-beacon shards follow beacon committee rotation through
+        # the epoch light chain (core/epochchain.py; populated by the
+        # beacon-epoch sync feed)
+        from .core.epochchain import EpochChain
+
+        epoch_chain_cell.append(EpochChain(
+            db, lambda s: list(chain.genesis.committee), engine=engine,
+        ))
+        reg_epoch_chain = epoch_chain_cell[0]
+    else:
+        reg_epoch_chain = None
     pool = TxPool(genesis.config.chain_id, cfg["shard_id"], chain.state)
 
     # BLS keys: encrypted keyfiles, or dev keys on the dev genesis
@@ -163,8 +209,17 @@ def build_node(cfg: dict):
     for peer in cfg["peers"]:
         addr, _, port = peer.rpartition(":")
         host.connect(int(port), addr or "127.0.0.1")
+    discovery = None
+    if cfg.get("bootnodes"):
+        from .p2p.discovery import Discovery
+
+        discovery = Discovery(host, bootnodes=cfg["bootnodes"]).start()
 
     reg = Registry(blockchain=chain, txpool=pool, host=host)
+    if discovery is not None:
+        reg.set("discovery", discovery)
+    if reg_epoch_chain is not None:
+        reg.set("beaconchain", reg_epoch_chain)
     node = Node(reg, keys, network=cfg["network"])
     hmy = Harmony(chain, pool, node)
 
@@ -175,6 +230,15 @@ def build_node(cfg: dict):
         ServiceType.CLIENT_SUPPORT,
         _CallbackService(rpc.start, rpc.stop),
     )
+
+    if cfg.get("ws_port") is not None:
+        from .rpc.ws import WSServer
+
+        ws = WSServer(rpc, port=cfg["ws_port"])
+        manager.register(
+            ServiceType.WEBSOCKET,
+            _CallbackService(ws.start, ws.stop),
+        )
 
     metrics_reg = MetricsRegistry()
     reg.set("metrics", metrics_reg)
@@ -190,6 +254,32 @@ def build_node(cfg: dict):
         _CallbackService(lambda: None, sync_srv.close),
     )
 
+    if discovery is not None:
+        manager.register(
+            ServiceType.NETWORK_INFO,
+            _CallbackService(lambda: None, discovery.stop),
+        )
+
+    if cfg.get("explorer_port") is not None:
+        from .explorer import ExplorerServer
+
+        explorer = ExplorerServer(chain, port=cfg["explorer_port"])
+        reg.set("explorer", explorer)
+        manager.register(
+            ServiceType.SUPPORT_EXPLORER,
+            _CallbackService(explorer.start, explorer.stop),
+        )
+
+    if cfg.get("rosetta_port") is not None:
+        from .rosetta import RosettaServer
+
+        rosetta = RosettaServer(hmy, port=cfg["rosetta_port"])
+        reg.set("rosetta", rosetta)
+        manager.register(
+            ServiceType.ROSETTA,
+            _CallbackService(rosetta.start, rosetta.stop),
+        )
+
     if cfg["sync_peers"]:
         clients = []
         for peer in cfg["sync_peers"]:
@@ -198,6 +288,9 @@ def build_node(cfg: dict):
         downloader = Downloader(chain, clients,
                                 verify_seals=chain.engine is not None)
         downloader.sync_once()  # catch up before consensus starts
+        # the node spins this up again if consensus detects it fell
+        # behind (node.py _spin_up_sync — consensus/downloader.go analog)
+        reg.set("downloader", downloader)
 
     consensus_thread: list = []
     manager.register(
@@ -221,7 +314,11 @@ def main(argv=None):
     p.add_argument("--p2p-port", type=int, dest="p2p_port")
     p.add_argument("--sync-port", type=int, dest="sync_port")
     p.add_argument("--peer", action="append", dest="peers")
+    p.add_argument("--bootnode", action="append", dest="bootnodes")
     p.add_argument("--sync-peer", action="append", dest="sync_peers")
+    p.add_argument("--verify-backend", dest="verify_backend",
+                   choices=["in-process", "sidecar"])
+    p.add_argument("--sidecar-addr", dest="sidecar_addr")
     p.add_argument("--no-native-kv", action="store_const", const=False,
                    default=None, dest="native_kv")
     p.add_argument("--skip-ntp-check", action="store_const", const=False,

@@ -66,3 +66,30 @@ def test_load_config_toml_and_overrides(tmp_path):
     assert cfg["shard_id"] == 3
     assert cfg["rpc_port"] == 4321  # flag beats file
     assert cfg["datadir"] == DEFAULTS["datadir"]
+
+
+def test_foreign_shard_committee_fails_closed(tmp_path):
+    """A foreign shard with no resolvable committee must yield a context
+    that rejects every proof — NOT the local genesis committee (advisor
+    r2: that verified cross-shard seals against the wrong key set)."""
+    cfg = load_config(None, {})
+    cfg.update(
+        datadir=str(tmp_path), in_memory=True, rpc_port=0,
+        metrics_port=0, p2p_port=0, sync_port=0, blocks_per_epoch=16,
+    )
+    node, manager, reg, rpc, metrics = build_node(cfg)
+    try:
+        engine = node.chain.engine
+        local = engine.epoch_context(cfg["shard_id"], 0)
+        assert len(local) > 0
+        foreign = engine.epoch_context(cfg["shard_id"] + 7, 0)
+        assert len(foreign) == 0  # empty context: fails closed
+        # an empty context rejects any (sig, bitmap) pair
+        from harmony_tpu.chain.header import Header
+
+        hdr = Header(shard_id=cfg["shard_id"] + 7, epoch=0)
+        assert not engine.verify_header_signature(
+            hdr, b"\x01" * 96, b"\xff"
+        )
+    finally:
+        manager.stop_services()

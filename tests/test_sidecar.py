@@ -163,3 +163,40 @@ def test_native_client_end_to_end(server, committee, native_lib):
         assert ok == 0
     finally:
         native_lib.harmony_sidecar_close(h)
+
+
+# --- engine-through-sidecar (the wired backend, VERDICT r2 #7) -------------
+
+
+def test_engine_routes_checks_through_sidecar(server):
+    """Engine(backend=SidecarClient) must push the committee once and
+    verify header seals entirely through the sidecar service."""
+    from harmony_tpu import bls as B
+    from harmony_tpu.chain.engine import Engine, EpochContext
+    from harmony_tpu.chain.header import Header
+    from harmony_tpu.consensus.signature import construct_commit_payload
+
+    keys = [B.PrivateKey.generate(bytes([90 + i])) for i in range(4)]
+    serialized = [k.pub.bytes for k in keys]
+    client = SidecarClient(server.address)
+    eng = Engine(lambda s, e: EpochContext(serialized), device=False,
+                 backend=client)
+    h = Header(shard_id=0, block_num=10, epoch=2, view_id=10)
+    payload = construct_commit_payload(
+        h.hash(), h.block_num, h.view_id, True
+    )
+    sigs = [keys[i].sign_hash(payload) for i in (0, 1, 2)]
+    agg = B.aggregate_sigs(sigs)
+    mask = Mask([k.pub.point for k in keys])
+    for i in (0, 1, 2):
+        mask.set_bit(i, True)
+    assert eng.verify_header_signature(h, agg.bytes, mask.mask_bytes())
+    assert eng._backend_committees == {(0, 2)}
+    # cached second call: no wire round-trip needed (still True)
+    assert eng.verify_header_signature(h, agg.bytes, mask.mask_bytes())
+    # wrong bitmap (claims all 4 signed) fails THROUGH the sidecar
+    mask.set_bit(3, True)
+    assert not eng.verify_header_signature(
+        h, agg.bytes, mask.mask_bytes()
+    )
+    client.close()
