@@ -1,66 +1,242 @@
-"""Benchmark: BLS12-381 pairing throughput on one chip.
+"""Benchmark: BLS12-381 quorum-crypto throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline (BASELINE.md): >= 50_000 pairings/s sustained on 1x TPU v5e.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Primary metric (BASELINE.md): >= 50_000 pairings/s sustained on 1x TPU
+v5e.  The same line carries the other BASELINE configs under "extra":
+  - agg_verify_p50_ms_1k_keys  (config #2: 1000-key masked aggregate
+    verify, < 2 ms p50 target)
+  - replay_headers_per_sec     (config #5: batched header-seal verify,
+    the block-replay throughput shape)
 
-Measures the batched full pairing (Miller loop + final exponentiation)
-at the largest batch that fits comfortably, steady-state (post-compile),
-wall-clock per device-complete iteration.
+Robustness contract (VERDICT r2 #1 — two rounds of rc=1/timeouts):
+this file must emit a parseable JSON line on EVERY exit path.  The
+axon TPU tunnel has two observed failure modes on this image: a hang
+inside backend init (r1) and a RuntimeError("Unable to initialize
+backend 'axon'") (r2).  Both are survived by running the measurement
+in a CHILD process: the parent arms a deadline, captures the child's
+output, and on any failure retries on the forced-CPU backend so the
+round still records a real measured number (clearly labeled) instead
+of a traceback.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+PRIMARY = "bls12_381_pairings_per_sec_per_chip"
+TARGET_PAIRINGS_S = 50_000.0
 
-def _arm_watchdog(seconds: int):
-    """The axon TPU tunnel can wedge with jax.devices() hanging forever
-    (observed in round 1); emit an honest zero-result instead of hanging
-    the driver."""
-    import threading
 
-    def fire():
-        print(
-            json.dumps(
-                {
-                    "metric": "bls12_381_pairings_per_sec_per_chip",
-                    "value": 0,
-                    "unit": "pairings/s",
-                    "vs_baseline": 0.0,
-                    "error": f"timeout after {seconds}s (TPU tunnel wedged?)",
-                }
-            ),
-            flush=True,
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _honest_zero(err: str, extra=None):
+    _emit(
+        {
+            "metric": PRIMARY,
+            "value": 0,
+            "unit": "pairings/s",
+            "vs_baseline": 0.0,
+            "error": err[-2000:],
+            "extra": extra or {},
+        }
+    )
+
+
+# ----------------------------------------------------------------------
+# parent: orchestrate child measurement processes
+# ----------------------------------------------------------------------
+
+
+def _run_child(force_cpu: bool, timeout_s: float):
+    """Run this file in --child mode; return (parsed_json | None, err)."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    # soft budget: the child stops measuring and emits its JSON before
+    # the parent's hard kill would discard everything
+    env["BENCH_CHILD_BUDGET"] = str(max(timeout_s - 30, 30))
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+        # XLA:CPU on this 1-core image: parallel LLVM codegen segfaults
+        # intermittently; serialize it (see tests/conftest.py).
+        flags = env.get("XLA_FLAGS", "")
+        if "parallel_codegen" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_cpu_parallel_codegen_split_count=1"
+            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
         )
-        os._exit(2)
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    return t
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode("utf-8", "replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        return None, f"child timeout after {timeout_s:.0f}s; stderr tail: {tail[-500:]}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed, ""
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, (
+        f"child rc={proc.returncode}; no JSON line; "
+        f"stderr tail: {proc.stderr[-800:]}"
+    )
 
 
 def main():
-    watchdog = _arm_watchdog(int(os.environ.get("BENCH_TIMEOUT", "3000")))
+    budget = float(os.environ.get("BENCH_TIMEOUT", "3000"))
+    t0 = time.monotonic()
+    # Attempt 1: default backend (TPU via the axon tunnel if alive).
+    # Give it at most 60% of the budget so a wedged tunnel still leaves
+    # room for the CPU fallback measurement.
+    result, err1 = _run_child(force_cpu=False, timeout_s=budget * 0.6)
+    if result is not None and not result.get("error"):
+        _emit(result)
+        return 0
+    # Attempt 2: forced CPU — a real measured number beats a traceback.
+    remaining = budget - (time.monotonic() - t0) - 10
+    if remaining < 60:
+        _honest_zero(f"tpu attempt failed ({err1}); no time left for cpu")
+        return 0
+    result2, err2 = _run_child(force_cpu=True, timeout_s=remaining)
+    if result2 is not None:
+        result2.setdefault("extra", {})["tpu_attempt_error"] = err1[-500:]
+        _emit(result2)
+        return 0
+    _honest_zero(f"tpu: {err1} || cpu: {err2}")
+    return 0
+
+
+# ----------------------------------------------------------------------
+# child: the actual measurements
+# ----------------------------------------------------------------------
+
+
+def _child():
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_CHILD_BUDGET", "1e9")
+    )
     import jax
 
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    if force_cpu:
+        # the axon sitecustomize force-selects "axon,cpu" via
+        # jax.config.update, overriding JAX_PLATFORMS — counter it
+        # before any backend initializes
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        cache = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    import jax.numpy as jnp
     import numpy as np
+    import jax.numpy as jnp
 
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    from harmony_tpu.ops import bls as OB
+    from harmony_tpu.ops import curve as CV
     from harmony_tpu.ops import interop as I
     from harmony_tpu.ops import pairing as OP
     from harmony_tpu.ref import bls as RB
-    from harmony_tpu.ref.curve import g1, g2, G1_GEN, G2_GEN
+    from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
+    from harmony_tpu.ref.hash_to_curve import hash_to_g2
 
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    extra = {"backend": backend, "configs_failed": []}
+    if not on_tpu:
+        # XLA:CPU cannot build ANY pairing-shaped program inside the
+        # budget on the 1-core fallback box (>20 min jit OR eager,
+        # measured 2026-07-29) — measure the HOST BIGINT twin instead
+        # so the round still records real numbers, clearly labeled.
+        extra["backend"] = "cpu-bigint-reference"
+        return _child_cpu_bigint(extra, deadline)
 
-    # distinct inputs (scalar multiples of the generators), tiled to batch
+    # ---- shared fixtures (small host-side setup) ----------------------
+    msg = b"bench-agg-verify-block-payload!!"
+    h_pt = hash_to_g2(msg)
+    n_keys = int(os.environ.get("BENCH_KEYS", "1000"))
+    sks = [RB.keygen(bytes([i % 251, i // 251])) for i in range(n_keys)]
+    pks = [RB.pubkey(sk) for sk in sks]
+    sigs = [RB.sign(sk, msg) for sk in sks]
+
+    # ---- config #2: 1000-key aggregate-verify p50 ---------------------
+    # Committee table resident on device; per call: bitmap + 96B sig in,
+    # bool out — the steady-state FBFT quorum check.
+    try:
+        from harmony_tpu import device as DV
+
+        table = DV.CommitteeTable(pks)
+        rng = np.random.default_rng(7)
+        lat = []
+        n_calls = int(os.environ.get("BENCH_AGG_CALLS", "12" if on_tpu else "4"))
+        for i in range(n_calls):
+            bits = np.ones(n_keys, dtype=np.int64)
+            # drop a random ~one-sixth of signers (stays over 2/3 quorum)
+            drop = rng.choice(n_keys, size=n_keys // 6, replace=False)
+            bits[drop] = 0
+            agg = RB.aggregate_sigs(
+                [s for s, b in zip(sigs, bits) if b]
+            )
+            t1 = time.perf_counter()
+            ok = DV.agg_verify_on_device(table, bits, msg, agg)
+            dt = time.perf_counter() - t1
+            if i > 0:  # first call pays compile
+                lat.append(dt)
+            assert ok, "agg_verify rejected a valid quorum!"
+            if time.monotonic() > deadline:
+                break
+        if lat:
+            extra["agg_verify_p50_ms_1k_keys"] = round(
+                sorted(lat)[len(lat) // 2] * 1e3, 3
+            )
+            extra["agg_verify_n_keys"] = n_keys
+    except Exception as e:  # noqa: BLE001 — report, don't crash the bench
+        extra["configs_failed"].append(f"agg_verify: {e!r:.300}")
+
+    # ---- config #5: replay throughput (batched seal verify) -----------
+    try:
+        from harmony_tpu import device as DV
+
+        width = int(os.environ.get("BENCH_REPLAY_WIDTH", "64"))
+        reps = int(os.environ.get("BENCH_REPLAY_REPS", "3" if on_tpu else "1"))
+        small_keys = pks[:250]  # mainnet historic committee size
+        small_sigs = sigs[:250]
+        tbl = DV.CommitteeTable(small_keys)
+        bits = np.ones(250, dtype=np.int64)
+        agg = RB.aggregate_sigs(small_sigs)
+        bl = [bits] * width
+        hl = [h_pt] * width
+        sl = [agg] * width
+        DV.agg_verify_batch_on_device(tbl, bl, hl, sl)  # compile + warm
+        best = None
+        for _ in range(reps):
+            t1 = time.perf_counter()
+            res = DV.agg_verify_batch_on_device(tbl, bl, hl, sl)
+            dt = time.perf_counter() - t1
+            best = dt if best is None else min(best, dt)
+            assert all(res), "replay batch rejected valid seals!"
+            if time.monotonic() > deadline:
+                break
+        extra["replay_headers_per_sec"] = round(width / best, 1)
+        extra["replay_committee_keys"] = 250
+    except Exception as e:  # noqa: BLE001
+        extra["configs_failed"].append(f"replay: {e!r:.300}")
+
+    # ---- primary: raw pairing throughput ------------------------------
+    batch = int(os.environ.get("BENCH_BATCH", "256" if on_tpu else "8"))
+    iters = int(os.environ.get("BENCH_ITERS", "3" if on_tpu else "1"))
     base_p = [G1_GEN, g1.dbl(G1_GEN), g1.mul(G1_GEN, 5), g1.mul(G1_GEN, 7)]
     base_q = [G2_GEN, g2.dbl(G2_GEN), g2.mul(G2_GEN, 5), g2.mul(G2_GEN, 7)]
     p_arr = I.g1_batch_affine(base_p)
@@ -81,24 +257,87 @@ def main():
 
     times = []
     for _ in range(iters):
-        t0 = time.perf_counter()
+        t1 = time.perf_counter()
         fn(ps, qs).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    pairings_per_s = batch / best
+        times.append(time.perf_counter() - t1)
+    pairings_per_s = batch / min(times)
 
-    watchdog.cancel()
-    print(
-        json.dumps(
-            {
-                "metric": "bls12_381_pairings_per_sec_per_chip",
-                "value": round(pairings_per_s, 1),
-                "unit": "pairings/s",
-                "vs_baseline": round(pairings_per_s / 50_000.0, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": PRIMARY,
+            "value": round(pairings_per_s, 1),
+            "unit": "pairings/s",
+            "vs_baseline": round(pairings_per_s / TARGET_PAIRINGS_S, 4),
+            "extra": extra,
+        }
     )
+    return 0
+
+
+def _child_cpu_bigint(extra, deadline):
+    """Honest fallback numbers from the bigint reference twin: the
+    driver's TPU tunnel has been dead in both prior rounds; a labeled
+    host measurement beats a traceback and gives optimization work a
+    floor to compare against."""
+    import time as _t
+
+    from harmony_tpu.ref import bls as RB
+    from harmony_tpu.ref import pairing as RP
+    from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
+    from harmony_tpu.ref.hash_to_curve import hash_to_g2
+
+    msg = b"bench-agg-verify-block-payload!!"
+    h_pt = hash_to_g2(msg)
+    n_keys = int(os.environ.get("BENCH_KEYS", "250"))
+    sks = [RB.keygen(bytes([i % 251, i // 251])) for i in range(n_keys)]
+    pks = [RB.pubkey(sk) for sk in sks]
+    sigs = [RB.sign(sk, msg) for sk in sks]
+
+    # config #2: n-key aggregate verify p50 (host path: bigint G1
+    # aggregation + one 2-pairing product)
+    try:
+        lat = []
+        for _ in range(5):
+            t1 = _t.perf_counter()
+            agg_sig = RB.aggregate_sigs(sigs)
+            agg_pk = RB.aggregate_pubkeys(pks)
+            assert RB.verify_hashed(agg_pk, h_pt, agg_sig)
+            lat.append(_t.perf_counter() - t1)
+            if _t.monotonic() > deadline:
+                break
+        extra["agg_verify_p50_ms_host"] = round(
+            sorted(lat)[len(lat) // 2] * 1e3, 1
+        )
+        extra["agg_verify_n_keys"] = n_keys
+        # replay throughput floor: one seal check per header
+        extra["replay_headers_per_sec_host"] = round(
+            1.0 / (sorted(lat)[len(lat) // 2]), 2
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["configs_failed"].append(f"agg_verify_host: {e!r:.300}")
+
+    # primary: raw bigint pairing throughput
+    n = 6
+    pairs = [
+        (g1.mul(G1_GEN, 3 + i), g2.mul(G2_GEN, 5 + i)) for i in range(n)
+    ]
+    t0 = _t.perf_counter()
+    for p, q in pairs:
+        RP.pairing(p, q)
+    rate = n / (_t.perf_counter() - t0)
+    _emit(
+        {
+            "metric": PRIMARY,
+            "value": round(rate, 2),
+            "unit": "pairings/s",
+            "vs_baseline": round(rate / TARGET_PAIRINGS_S, 6),
+            "extra": extra,
+        }
+    )
+    return 0
 
 
 if __name__ == "__main__":
+    if "--child" in sys.argv or os.environ.get("BENCH_CHILD") == "1":
+        sys.exit(_child())
     sys.exit(main())
