@@ -271,8 +271,95 @@ def _pc_modexp(data: bytes, gas: int):
     return gas - cost, out
 
 
-def _pc_unsupported(data: bytes, gas: int):
-    raise VMError("unsupported precompile")
+def _bn_g1_from(data: bytes):
+    """EIP-196 G1 decode: 64 BE bytes; (0, 0) = infinity; coordinates
+    must be < p and on the curve."""
+    from .. import crypto_bn256 as BN
+
+    x = int.from_bytes(data[:32], "big")
+    y = int.from_bytes(data[32:64], "big")
+    if x >= BN.P or y >= BN.P:
+        raise VMError("bn256 coordinate out of range")
+    if x == 0 and y == 0:
+        return None
+    pt = (x, y)
+    if not BN.g1_on_curve(pt):
+        raise VMError("bn256 point not on curve")
+    return pt
+
+
+def _pc_bn256_add(data: bytes, gas: int):
+    from .. import crypto_bn256 as BN
+
+    if gas < 150:  # Istanbul (EIP-1108)
+        raise VMError("precompile oog")
+    data = data.ljust(128, b"\x00")
+    out = BN.g1_add(_bn_g1_from(data[:64]), _bn_g1_from(data[64:128]))
+    x, y = out if out is not None else (0, 0)
+    return gas - 150, x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _pc_bn256_mul(data: bytes, gas: int):
+    from .. import crypto_bn256 as BN
+
+    if gas < 6000:
+        raise VMError("precompile oog")
+    data = data.ljust(96, b"\x00")
+    k = int.from_bytes(data[64:96], "big")
+    out = BN.g1_mul(_bn_g1_from(data[:64]), k)
+    x, y = out if out is not None else (0, 0)
+    return gas - 6000, x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _pc_bn256_pairing(data: bytes, gas: int):
+    from .. import crypto_bn256 as BN
+
+    if len(data) % 192:
+        raise VMError("bn256 pairing input not a multiple of 192")
+    k = len(data) // 192
+    cost = 45000 + 34000 * k  # Istanbul (EIP-1108)
+    if gas < cost:
+        raise VMError("precompile oog")
+    pairs = []
+    for i in range(k):
+        chunk = data[i * 192:(i + 1) * 192]
+        p = _bn_g1_from(chunk[:64])
+        # EIP-197 G2 encoding: x = a*i + b as (a, b), y likewise —
+        # imaginary component FIRST
+        xi_ = int.from_bytes(chunk[64:96], "big")
+        xr = int.from_bytes(chunk[96:128], "big")
+        yi = int.from_bytes(chunk[128:160], "big")
+        yr = int.from_bytes(chunk[160:192], "big")
+        if max(xi_, xr, yi, yr) >= BN.P:
+            raise VMError("bn256 coordinate out of range")
+        if xi_ == xr == yi == yr == 0:
+            q = None
+        else:
+            q = ((xr, xi_), (yr, yi))
+            if not BN.g2_in_subgroup(q):
+                raise VMError("bn256 G2 point not in subgroup")
+        pairs.append((p, q))
+    ok = BN.pairing_check(pairs)
+    return gas - cost, (1 if ok else 0).to_bytes(32, "big")
+
+
+def _pc_blake2f(data: bytes, gas: int):
+    import struct
+
+    from ..crypto_bn256 import blake2f
+
+    if len(data) != 213:
+        raise VMError("blake2f input must be 213 bytes")
+    rounds = int.from_bytes(data[:4], "big")
+    if data[212] not in (0, 1):
+        raise VMError("blake2f final flag must be 0 or 1")
+    if gas < rounds:  # EIP-152: 1 gas per round
+        raise VMError("precompile oog")
+    h = list(struct.unpack("<8Q", data[4:68]))
+    m = list(struct.unpack("<16Q", data[68:196]))
+    t = list(struct.unpack("<2Q", data[196:212]))
+    out = blake2f(rounds, h, m, t, data[212] == 1)
+    return gas - rounds, struct.pack("<8Q", *out)
 
 
 PRECOMPILES = {
@@ -281,13 +368,12 @@ PRECOMPILES = {
     3: _pc_ripemd160,
     4: _pc_identity,
     5: _pc_modexp,
-    # bn256 add/mul/pairing + blake2f: unimplemented by design — calls
-    # FAIL (the reference supports them via cgo; no BN254 lattice here,
-    # and silently succeeding would fork state vs a correct chain)
-    6: _pc_unsupported,
-    7: _pc_unsupported,
-    8: _pc_unsupported,
-    9: _pc_unsupported,
+    # alt_bn128 + blake2f (reference: go-ethereum cgo contracts;
+    # crypto_bn256.py is the from-scratch bigint twin)
+    6: _pc_bn256_add,
+    7: _pc_bn256_mul,
+    8: _pc_bn256_pairing,
+    9: _pc_blake2f,
 }
 
 

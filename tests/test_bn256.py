@@ -1,0 +1,107 @@
+"""alt_bn128 precompiles + blake2f (reference: core/vm/contracts.go
+bn256Add/ScalarMul/Pairing via cgo — VERDICT r2 missing #6's bn256
+hole; crypto_bn256.py is the bigint twin)."""
+
+import hashlib
+import struct
+
+import pytest
+
+from harmony_tpu import crypto_bn256 as BN
+from harmony_tpu.core.vm import PRECOMPILES, VMError
+
+# EIP-196's doubling vector: 2 * (1, 2)
+TWO_G = (
+    1368015179489954701390400359078579693043519447331113978918064868415326638035,
+    9918110051302171585080402603319702774565515993150576347155970296011118125764,
+)
+
+
+def test_g1_double_matches_known_vector():
+    assert BN.g1_mul(BN.G1_GEN, 2) == TWO_G
+    assert BN.g1_add(BN.G1_GEN, BN.G1_GEN) == TWO_G
+
+
+def test_pairing_bilinear_and_order():
+    e1 = BN.pairing(BN.G1_GEN, BN.G2_GEN)
+    assert e1 != BN.F12_ONE
+    assert BN.f12_pow(e1, BN.N) == BN.F12_ONE
+    assert BN.pairing(BN.g1_mul(BN.G1_GEN, 3), BN.G2_GEN) == \
+        BN.pairing(BN.G1_GEN, BN.g2_mul(BN.G2_GEN, 3))
+
+
+def _enc_g1(pt):
+    x, y = pt if pt is not None else (0, 0)
+    return x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _enc_g2(pt):
+    (xr, xi), (yr, yi) = pt
+    return b"".join(v.to_bytes(32, "big") for v in (xi, xr, yi, yr))
+
+
+def test_precompile_bn256_add_and_mul():
+    add = PRECOMPILES[6]
+    gas, out = add(_enc_g1(BN.G1_GEN) + _enc_g1(BN.G1_GEN), 10_000)
+    assert out == _enc_g1(TWO_G)
+    # infinity + P = P; short input right-padded with zeros
+    gas, out = add(_enc_g1(BN.G1_GEN), 10_000)
+    assert out == _enc_g1(BN.G1_GEN)
+    mul = PRECOMPILES[7]
+    gas, out = mul(
+        _enc_g1(BN.G1_GEN) + (2).to_bytes(32, "big"), 10_000
+    )
+    assert out == _enc_g1(TWO_G)
+    # off-curve input rejected
+    bad = (1).to_bytes(32, "big") + (3).to_bytes(32, "big")
+    with pytest.raises(VMError):
+        add(bad + _enc_g1(BN.G1_GEN), 10_000)
+    with pytest.raises(VMError):
+        add(_enc_g1(BN.G1_GEN) + _enc_g1(BN.G1_GEN), 10)  # oog
+
+
+def test_precompile_bn256_pairing():
+    pairing = PRECOMPILES[8]
+    neg = (BN.G1_GEN[0], (-BN.G1_GEN[1]) % BN.P)
+    good = (
+        _enc_g1(BN.G1_GEN) + _enc_g2(BN.G2_GEN)
+        + _enc_g1(neg) + _enc_g2(BN.G2_GEN)
+    )
+    gas, out = pairing(good, 200_000)
+    assert out == (1).to_bytes(32, "big")
+    bad = _enc_g1(BN.G1_GEN) + _enc_g2(BN.G2_GEN)
+    gas, out = pairing(bad, 200_000)
+    assert out == (0).to_bytes(32, "big")
+    # empty input: vacuous product == 1 (EIP-197)
+    gas, out = pairing(b"", 50_000)
+    assert out == (1).to_bytes(32, "big")
+    with pytest.raises(VMError):
+        pairing(good[:100], 200_000)  # not a multiple of 192
+    # G2 point off the subgroup rejected: use a curve point that is
+    # not order-n (double of an off-subgroup point construction is
+    # expensive; tamper y to leave the curve instead)
+    tampered = bytearray(good)
+    tampered[64 + 127] ^= 1
+    with pytest.raises(VMError):
+        pairing(bytes(tampered), 200_000)
+
+
+def test_precompile_blake2f_matches_hashlib():
+    # one-block blake2b("abc") via the F precompile
+    h = list(BN._BLAKE2B_IV)
+    h[0] ^= 0x01010000 ^ 64
+    block = b"abc".ljust(128, b"\x00")
+    data = (
+        (12).to_bytes(4, "big")
+        + struct.pack("<8Q", *h)
+        + block
+        + struct.pack("<2Q", 3, 0)
+        + b"\x01"
+    )
+    gas, out = PRECOMPILES[9](data, 1000)
+    assert out == hashlib.blake2b(b"abc").digest()
+    assert gas == 1000 - 12
+    with pytest.raises(VMError):
+        PRECOMPILES[9](data[:-1], 1000)  # wrong length
+    with pytest.raises(VMError):
+        PRECOMPILES[9](data[:-1] + b"\x02", 1000)  # bad flag
