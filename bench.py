@@ -169,7 +169,9 @@ def _child():
     n_keys = int(os.environ.get("BENCH_KEYS", "1000"))
     sks = [RB.keygen(bytes([i % 251, i // 251])) for i in range(n_keys)]
     pks = [RB.pubkey(sk) for sk in sks]
-    sigs = [RB.sign(sk, msg) for sk in sks]
+    # sign via the precomputed message point: RB.sign would redo the
+    # host hash-to-G2 n_keys times (fixture setup, not the measurement)
+    sigs = [g2.mul(h_pt, sk) for sk in sks]
 
     # ---- config #2: 1000-key aggregate-verify p50 ---------------------
     # Committee table resident on device; per call: bitmap + 96B sig in,
@@ -288,16 +290,16 @@ def _child_cpu_bigint(extra, deadline):
 
     msg = b"bench-agg-verify-block-payload!!"
     h_pt = hash_to_g2(msg)
-    n_keys = int(os.environ.get("BENCH_KEYS", "250"))
+    n_keys = int(os.environ.get("BENCH_KEYS", "250"))  # config #2 size
     sks = [RB.keygen(bytes([i % 251, i // 251])) for i in range(n_keys)]
     pks = [RB.pubkey(sk) for sk in sks]
-    sigs = [RB.sign(sk, msg) for sk in sks]
+    sigs = [g2.mul(h_pt, sk) for sk in sks]  # precomputed-h signing
 
     # config #2: n-key aggregate verify p50 (host path: bigint G1
     # aggregation + one 2-pairing product)
     try:
         lat = []
-        for _ in range(5):
+        for _ in range(3):
             t1 = _t.perf_counter()
             agg_sig = RB.aggregate_sigs(sigs)
             agg_pk = RB.aggregate_pubkeys(pks)

@@ -23,6 +23,7 @@ discipline (the reference keeps both in one pool as well).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 
@@ -65,6 +66,9 @@ class TxPool:
         self._by_sender: dict[bytes, dict[int, _Entry]] = {}
         self._count = 0
         self.evicted = 0
+        # the pool is shared between the consensus pump and RPC server
+        # threads (sendRawTransaction) — every public method locks
+        self._lock = threading.RLock()
 
     # -- tier classification -------------------------------------------------
 
@@ -78,7 +82,7 @@ class TxPool:
                 nonce += 1
         return execn, self._count - execn
 
-    def stats(self):
+    def _stats_unlocked(self):
         """(pending, queued) — the reference's Stats()."""
         return self._split_counts(self._state_view())
 
@@ -137,7 +141,7 @@ class TxPool:
         self.evicted += 1
         return True
 
-    def add(self, tx, is_staking: bool = False) -> bytes:
+    def _add_unlocked(self, tx, is_staking: bool = False) -> bytes:
         """Admit a tx; returns the recovered sender. Raises PoolError."""
         sender = self._validate(tx, is_staking)
         state = self._state_view()
@@ -169,7 +173,7 @@ class TxPool:
 
     # -- selection ---------------------------------------------------------
 
-    def pending(self, max_txs: int = 0):
+    def _pending_unlocked(self, max_txs: int = 0):
         """Executable (tx, is_staking) pairs: gapless nonce runs per
         sender, merged by descending gas price (the proposer's read —
         reference: node/harmony/worker block assembly)."""
@@ -200,7 +204,7 @@ class TxPool:
                 break
         return out
 
-    def queued(self):
+    def _queued_unlocked(self):
         """Non-executable (tx, is_staking) pairs (future-nonce tail)."""
         state = self._state_view()
         out = []
@@ -216,7 +220,7 @@ class TxPool:
 
     # -- maintenance -------------------------------------------------------
 
-    def drop_applied(self):
+    def _drop_applied_unlocked(self):
         """Prune txs whose nonce is now below the state nonce (called
         after a block commits); queued txs just above the new nonce
         become executable implicitly (promotion is the tier REREAD)."""
@@ -230,7 +234,7 @@ class TxPool:
             if not slots:
                 del self._by_sender[sender]
 
-    def evict_stale(self, now: float | None = None):
+    def _evict_stale_unlocked(self, now: float | None = None):
         """Drop queued txs older than the lifetime (reference: the 3h
         queue eviction loop)."""
         now = time.monotonic() if now is None else now
@@ -252,3 +256,30 @@ class TxPool:
 
     def __len__(self):
         return self._count
+
+
+    # -- locked public surface (see _lock above) ---------------------------
+
+    def stats(self):
+        with self._lock:
+            return self._stats_unlocked()
+
+    def add(self, tx, is_staking: bool = False) -> bytes:
+        with self._lock:
+            return self._add_unlocked(tx, is_staking)
+
+    def pending(self, max_txs: int = 0):
+        with self._lock:
+            return self._pending_unlocked(max_txs)
+
+    def queued(self):
+        with self._lock:
+            return self._queued_unlocked()
+
+    def drop_applied(self):
+        with self._lock:
+            return self._drop_applied_unlocked()
+
+    def evict_stale(self, now: float | None = None):
+        with self._lock:
+            return self._evict_stale_unlocked(now)

@@ -193,6 +193,94 @@ class Harmony:
             ],
         }
 
+    def delegations_by_delegator(self, delegator: bytes) -> list:
+        """Every (validator, amount, reward) this address delegates to
+        (reference: rpc GetDelegationsByDelegator)."""
+        out = []
+        state = self.chain.state()
+        for addr in state.validator_addresses():
+            w = state.validator(addr)
+            for d in w.delegations:
+                if d.delegator == delegator:
+                    out.append({
+                        "validator_address": "0x" + addr.hex(),
+                        "delegator_address": "0x" + delegator.hex(),
+                        "amount": d.amount,
+                        "reward": d.reward,
+                        "undelegations": [
+                            {"amount": a, "epoch": e}
+                            for a, e in d.undelegations
+                        ],
+                    })
+        return out
+
+    def delegations_by_validator(self, validator: bytes) -> list:
+        """All delegations into one validator (reference:
+        rpc GetDelegationsByValidator)."""
+        w = self.chain.state().validator(validator)
+        if w is None:
+            return []
+        return [
+            {
+                "validator_address": "0x" + validator.hex(),
+                "delegator_address": "0x" + d.delegator.hex(),
+                "amount": d.amount,
+                "reward": d.reward,
+                "undelegations": [
+                    {"amount": a, "epoch": e} for a, e in d.undelegations
+                ],
+            }
+            for d in w.delegations
+        ]
+
+    def elected_validator_addresses(self) -> list:
+        """Validators in the CURRENT epoch's committee (reference:
+        rpc GetElectedValidatorAddresses)."""
+        state = self.chain.shard_state_for_epoch(self.current_epoch())
+        if state is None:
+            return []
+        out = set()
+        for com in state.shards:
+            for slot in com.slots:
+                if slot.effective_stake is not None:
+                    out.add(slot.ecdsa_address)
+        return sorted(out)
+
+    def median_raw_stake_snapshot(self):
+        """The EPoS median-stake view of the upcoming auction
+        (reference: rpc GetMedianRawStakeSnapshot over
+        staking/effective's compute) — same eligibility filter and
+        slot budget as the real election (chain/finalize.py elect)."""
+        from ..staking.effective import SlotOrder, compute
+
+        state = self.chain.state()
+        orders = {}
+        for addr in state.validator_addresses():
+            w = state.validator(addr)
+            if w.status != 0 or not w.bls_keys:
+                continue
+            if w.self_delegation() < w.min_self_delegation:
+                continue
+            orders[addr] = SlotOrder(
+                stake=w.total_delegation(),
+                spread_among=list(w.bls_keys),
+                address=addr,
+            )
+        if not orders:
+            return {"median_raw_stake": "0", "slot_count": 0}
+        fin = getattr(self.chain, "finalizer", None)
+        if fin is not None and getattr(fin, "cfg", None) is not None:
+            pull = (
+                fin.cfg.external_slots_per_shard * fin.cfg.shard_count
+            )
+        else:  # no finalizer wired (dev chains): whole candidate set
+            pull = sum(len(o.spread_among) for o in orders.values())
+        med, purchases = compute(orders, pull)
+        return {
+            "median_raw_stake": str(med),
+            "slot_count": len(purchases),
+        }
+
     def total_staking(self) -> int:
         """Network total delegation (cached per epoch — hmy.go:73
         totalStakeCache)."""

@@ -38,10 +38,20 @@ def protocol_id(network: str, shard_id: int) -> str:
 
 
 class SyncServer:
-    """Serves a chain over the stream protocol."""
+    """Serves a chain over the stream protocol.
 
-    def __init__(self, chain, listen_port: int = 0):
+    Per-connection request rate limiting mirrors the reference's
+    stream-layer rate limiter tiers (p2p/stream rate limiting): a
+    token bucket refilled at ``rate_per_sec`` with ``burst`` capacity;
+    a peer that exceeds it gets throttled, not disconnected (lagging
+    nodes catching up are bursty by design)."""
+
+    def __init__(self, chain, listen_port: int = 0,
+                 rate_per_sec: float = 200.0, burst: int = 400):
+        from ..ratelimit import RateLimiter
+
         self.chain = chain
+        self.limiter = RateLimiter(rate_per_sec, burst)
         self._closing = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -61,6 +71,7 @@ class SyncServer:
             ).start()
 
     def _serve_conn(self, sock):
+        conn_key = str(id(sock))
         try:
             while not self._closing:
                 hdr = _recv_exact(sock, _HDR.size)
@@ -70,6 +81,9 @@ class SyncServer:
                 body = _recv_exact(sock, ln)
                 if body is None or kind != _REQ:
                     return
+                # back-pressure, not drop: every request consumes a
+                # token, waiting for one when the bucket is dry
+                self.limiter.wait(conn_key)
                 resp = self._handle(body)
                 sock.sendall(_HDR.pack(len(resp), _RESP, req_id) + resp)
         except OSError:

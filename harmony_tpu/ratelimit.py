@@ -1,0 +1,35 @@
+"""Token-bucket rate limiting, shared by the RPC and sync-stream
+servers (reference: rpc rate limiting, rpc.go:158-216 + the p2p/stream
+rate-limiter tiers)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class RateLimiter:
+    """Token bucket per key (client ip, connection id, ...)."""
+
+    def __init__(self, per_second: float = 100.0, burst: int = 200):
+        self.rate = per_second
+        self.burst = burst
+        self._state: dict = {}
+        self._lock = threading.Lock()
+
+    def allow(self, key: str) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._state.get(key, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens < 1.0:
+                self._state[key] = (tokens, now)
+                return False
+            self._state[key] = (tokens - 1.0, now)
+            return True
+
+    def wait(self, key: str):
+        """Block until a token is available, then consume it — the
+        back-pressure shape (serve slowly, never drop)."""
+        while not self.allow(key):
+            time.sleep(1.0 / self.rate)

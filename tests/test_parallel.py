@@ -1,10 +1,10 @@
 """Multi-chip sharding validation: run dryrun_multichip in a subprocess
 with 8 virtual CPU devices (see conftest.py for why not in-process).
 
-This compiles the full sharded quorum-check step (shard_map masked
-aggregation with an all_gather + data-parallel verify) from scratch each
-run, so it is the slowest test in the suite; skip with
--k 'not multichip' when iterating elsewhere.
+CPU-virtualized dryruns compile the SHARDED collective half and decide
+the pairing with the bigint reference (see __graft_entry__ docstring —
+measured 253 s from scratch on the 1-core CI box), so the budget here
+is the driver-shaped 600 s, not the old 3600 s.
 """
 
 import os
@@ -29,7 +29,7 @@ def test_dryrun_multichip_8_devices():
         env=env,
         capture_output=True,
         text=True,
-        timeout=3600,
+        timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip OK" in proc.stdout

@@ -238,3 +238,40 @@ def test_rpc_receipt_logs_filters_and_call(stack):
         ["0x" + invoke.hash(CHAIN_ID).hex()],
     )["result"]
     assert trace["type"] == "CALL" and trace["to"] == ca[2:].lower()
+
+
+def test_rpc_staking_reads(stack):
+    """Delegation/election/median-stake reads (reference: rpc
+    staking.go GetDelegationsBy*/GetElectedValidatorAddresses/
+    GetMedianRawStakeSnapshot)."""
+    from harmony_tpu.core.state import Delegation, ValidatorWrapper
+
+    srv, hmy, keys, to, tx = stack
+    state = hmy.chain.state()
+    vaddr = b"\x61" * 20
+    delegator = b"\x62" * 20
+    state.set_validator(ValidatorWrapper(
+        address=vaddr, bls_keys=[b"\x07" * 48],
+        delegations=[Delegation(vaddr, 1000),
+                     Delegation(delegator, 250, reward=9)],
+    ))
+    out = _call(
+        srv.port, "hmy_getDelegationsByDelegator",
+        ["0x" + delegator.hex()],
+    )["result"]
+    assert len(out) == 1
+    assert out[0]["validator_address"] == "0x" + vaddr.hex()
+    assert out[0]["amount"] == 250 and out[0]["reward"] == 9
+    out = _call(
+        srv.port, "hmy_getDelegationsByValidator", ["0x" + vaddr.hex()],
+    )["result"]
+    assert {d["delegator_address"] for d in out} == {
+        "0x" + vaddr.hex(), "0x" + delegator.hex(),
+    }
+    snap = _call(srv.port, "hmy_getMedianRawStakeSnapshot")["result"]
+    assert snap["slot_count"] == 1
+    assert int(float(snap["median_raw_stake"])) > 0
+    # no election recorded yet in this dev chain
+    assert _call(
+        srv.port, "hmy_getElectedValidatorAddresses"
+    )["result"] == []
