@@ -254,6 +254,42 @@ class Blockchain:
             raise ChainError("state root mismatch after execution")
         return state, result, elected
 
+    def revert_to(self, num: int) -> int:
+        """Roll the chain head back to block ``num`` (reference:
+        cmd/harmony's revert tooling / core RevertChain): resets the
+        head pointer and live state to the target block and drops the
+        canonical entries above it.  Returns how many blocks were
+        reverted.  State snapshots/bodies above stay in the KV store
+        (log-structured; unreachable entries are harmless), the
+        canonical number index is what defines the chain."""
+        with self._insert_lock:
+            head = self.head_number
+            if num >= head:
+                return 0
+            target = self.header_by_number(num)
+            if target is None:
+                raise ChainError(f"no canonical block {num} to revert to")
+            for n in range(head, num, -1):
+                # un-mark cx batches the reverted block consumed —
+                # re-syncing the same block must not read as a double
+                # spend (the whole point of reverting is to replay)
+                block = self.block_by_number(n)
+                if block is not None:
+                    for proof in block.incoming_receipts:
+                        try:
+                            src = rawdb.decode_header(proof.header_bytes)
+                        except (ValueError, IndexError):
+                            continue
+                        rawdb.delete_cx_spent(
+                            self.db, src.shard_id, src.block_num
+                        )
+                rawdb.delete_canonical(self.db, n)
+            rawdb.write_head_number(self.db, num)
+            self._head_num = num
+            self._state = self._load_state_at(num)
+            self._committee_cache.clear()
+            return head - num
+
     def verify_incoming_receipts(self, block: Block) -> list:
         """Reject unauthenticated / double-spent CX batches (reference:
         core/blockchain_impl.go:441-478 VerifyIncomingReceipts).  Raises

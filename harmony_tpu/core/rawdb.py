@@ -227,6 +227,19 @@ def read_canonical_hash(db, num: int) -> bytes | None:
     return db.get(_num_key(_CANON, num))
 
 
+def delete_canonical(db, num: int):
+    """Drop block ``num`` from the canonical chain (revert tooling);
+    the hash->number index entry goes with it."""
+    h = db.get(_num_key(_CANON, num))
+    if h is not None:
+        db.delete(_NUM_BY_HASH + h)
+    db.delete(_num_key(_CANON, num))
+    db.delete(_num_key(_HEADER, num))
+    db.delete(_num_key(_BODY, num))
+    db.delete(_num_key(_COMMIT_SIG, num))
+    db.delete(_RECEIPTS + _enc_int(num))
+
+
 def read_block_number(db, block_hash: bytes) -> int | None:
     blob = db.get(_NUM_BY_HASH + block_hash)
     return int.from_bytes(blob, "little") if blob else None
@@ -308,6 +321,15 @@ def write_cx_spent(db, from_shard: int, num: int):
     a later block must fail as a double spend)."""
     db.put(_CX_SPENT + from_shard.to_bytes(4, "little")
            + num.to_bytes(8, "little"), b"\x01")
+
+
+def delete_cx_spent(db, from_shard: int, num: int):
+    """Un-mark a receipt batch (revert tooling: a reverted block's
+    proofs must be acceptable again when the block re-syncs)."""
+    db.delete(
+        _CX_SPENT + from_shard.to_bytes(4, "little")
+        + num.to_bytes(8, "little")
+    )
 
 
 def is_cx_spent(db, from_shard: int, num: int) -> bool:

@@ -29,6 +29,7 @@ _REQ, _RESP = 1, 2
 METHOD_BLOCK_HASHES = 1    # [u64 start][u32 count] -> [hash...]
 METHOD_BLOCKS_BY_NUM = 2   # [u64 start][u32 count] -> [block blob...]
 METHOD_HEAD = 3            # [] -> [u64 head][32B hash]
+METHOD_EPOCH_STATE = 4     # [u64 epoch] -> [encoded shard state | empty]
 MAX_BLOCKS_PER_REQUEST = 128  # server-side clamp
 
 
@@ -103,6 +104,12 @@ class SyncServer:
                 head.to_bytes(8, "little")
                 + self.chain.current_header().hash()
             )
+        if method == METHOD_EPOCH_STATE:
+            epoch = r.int_()
+            state = rawdb.read_shard_state(self.chain.db, epoch)
+            if state is None:
+                return b""
+            return rawdb.encode_shard_state(state)
         start = r.int_()
         count = min(r.int_(4), MAX_BLOCKS_PER_REQUEST)
         if method == METHOD_BLOCK_HASHES:
@@ -199,6 +206,16 @@ class SyncClient:
                 (Block(header, txs, stxs, cxs, order), sig or None)
             )
         return out
+
+    def get_epoch_state(self, epoch: int):
+        """The elected shard State recorded for ``epoch`` on the remote
+        chain, or None (feeds the beacon EpochChain)."""
+        resp = self._call(
+            bytes([METHOD_EPOCH_STATE]) + epoch.to_bytes(8, "little")
+        )
+        if not resp:
+            return None
+        return rawdb.decode_shard_state(resp)
 
     def close(self):
         try:

@@ -445,3 +445,48 @@ def test_pool_lifetime_eviction():
     pool.evict_stale(now=_t.monotonic() + 11.0)
     assert len(pool) == 1
     assert pool.stats() == (1, 0)
+
+
+def test_revert_to_rolls_back_head_and_state():
+    """Chain revert tooling (reference: cmd/harmony revert commands):
+    head, live state, and canonical indices roll back; the chain can
+    then advance again from the revert point."""
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.node.worker import Worker
+
+    genesis, keys, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    worker = Worker(chain, pool)
+    to = b"\x0e" * 20
+    hashes = {}
+    for i in range(4):
+        tx = _transfer(keys[0], i, to, 10)
+        pool.add(tx)
+        block = worker.propose_block(view_id=i + 1)
+        chain.insert_chain([block], verify_seals=False)
+        pool.drop_applied()
+        hashes[i + 1] = block.hash()
+    assert chain.head_number == 4
+    assert chain.state().balance(to) == 40
+
+    assert chain.revert_to(2) == 2
+    assert chain.head_number == 2
+    assert chain.state().balance(to) == 20
+    assert chain.current_header().hash() == hashes[2]
+    assert chain.block_by_number(3) is None
+    assert rawdb.read_canonical_hash(chain.db, 4) is None
+    assert rawdb.read_block_number(chain.db, hashes[4]) is None
+    # reverting to the head or future is a no-op
+    assert chain.revert_to(2) == 0
+    assert chain.revert_to(99) == 0
+
+    # the chain advances again from block 2 (nonces follow state)
+    tx = _transfer(keys[0], 2, to, 10)
+    pool.add(tx)
+    block = worker.propose_block(view_id=3)
+    chain.insert_chain([block], verify_seals=False)
+    assert chain.head_number == 3
+    assert chain.state().balance(to) == 30

@@ -84,3 +84,49 @@ def test_epochchain_idempotent_reinsert(committee):
     h2 = Header(shard_id=0, block_num=17, epoch=0, view_id=17)
     ec.insert(h2, _elected_state(serialized))  # same epoch: no-op
     assert ec.header_for_epoch(0).hash() == h.hash()
+
+
+def test_epoch_feed_follows_beacon(committee):
+    """EpochFeed pulls boundary headers + elected states over the sync
+    stream into the EpochChain (reference: the staged sync's
+    epoch-block stage feeding core/epochchain.go)."""
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.node.worker import Worker
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+    from harmony_tpu.sync.epoch_feed import EpochFeed
+
+    _, serialized = committee
+    bpe = 4
+    genesis, keys, _bls = dev_genesis()
+    beacon = Blockchain(MemKV(), genesis, blocks_per_epoch=bpe)
+    pool = TxPool(2, 0, beacon.state)
+    worker = Worker(beacon, pool)
+    # two full epochs of empty blocks
+    for i in range(2 * bpe):
+        block = worker.propose_block(view_id=i + 1)
+        beacon.insert_chain([block], verify_seals=False)
+        beacon.write_commit_sig(
+            block.block_num, b"\x01" * 96 + b"\x0f"
+        )
+    # elections recorded for epochs 1 and 2
+    rawdb.write_shard_state(beacon.db, 1, _elected_state(serialized, 1))
+    rawdb.write_shard_state(beacon.db, 2, _elected_state(serialized, 1))
+
+    srv = SyncServer(beacon, listen_port=0)
+    try:
+        client = SyncClient(srv.port)
+        ec = EpochChain(MemKV(), lambda s: serialized)  # engine-less
+        feed = EpochFeed(ec, client, blocks_per_epoch=bpe)
+        n = feed.feed_once()
+        assert n == 2
+        assert ec.head_epoch() == 1
+        # committees for epochs 1 and 2 now resolve on the shard side
+        assert ec.committee_for(1, 1) == serialized
+        assert ec.committee_for(1, 2) == serialized
+        # idempotent second pass
+        assert feed.feed_once() == 0
+    finally:
+        srv.close()

@@ -408,3 +408,38 @@ def test_vrf_gated_proposal_carries_verifiable_proof():
     _, bad_proof = crypto_vrf.evaluate(other, parent_hash)
     with pytest.raises(ValueError):
         crypto_vrf.verify(bls_keys[0].pub, parent_hash, bad_proof)
+
+
+def test_operator_distinct_leader_rotation():
+    """With the LeaderRotation gate active, a multi-key operator gets
+    ONE leadership turn per cycle (quorum.go NthNextValidator
+    semantics)."""
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.shard.committee import Committee, Slot, State
+
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=4)
+    genesis.config.leader_rotation_epoch = 0
+    net = InProcessNetwork()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    # operator A runs slots 0-2 (3 keys), operator B runs slot 3
+    serialized = [k.pub.bytes for k in bls_keys]
+    state = State(epoch=0, shards=[Committee(shard_id=0, slots=[
+        Slot(ecdsa_address=b"\xaa" * 20, bls_pubkey=serialized[0]),
+        Slot(ecdsa_address=b"\xaa" * 20, bls_pubkey=serialized[1]),
+        Slot(ecdsa_address=b"\xaa" * 20, bls_pubkey=serialized[2]),
+        Slot(ecdsa_address=b"\xbb" * 20, bls_pubkey=serialized[3]),
+    ])])
+    rawdb.write_shard_state(chain.db, 0, state)
+    chain._committee_cache.clear()
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    reg = Registry(blockchain=chain, txpool=pool, host=net.host("r"))
+    node = Node(reg, PrivateKeys.from_keys([bls_keys[0]]))
+    # cycle length = number of DISTINCT operators (2), not slots (4):
+    # view v -> operator (v % 2)'s first slot key
+    assert node.leader_key(0) == serialized[0]  # operator A
+    assert node.leader_key(1) == serialized[3]  # operator B
+    assert node.leader_key(2) == serialized[0]  # back to A — one turn
+    assert node.leader_key(3) == serialized[3]
+    # without the gate: uniform over all 4 slots
+    genesis.config.leader_rotation_epoch = None
+    assert [node.leader_key(v) for v in range(4)] == serialized

@@ -1,0 +1,132 @@
+"""Localnet launcher: N validator processes + a bootnode on one machine.
+
+The role of the reference's test/deploy.sh + test/configs/ (the
+localnet tier of SURVEY §4): spawn a bootnode and one process per
+validator, wire discovery + sync peers, wait for blocks to flow, and
+tear everything down on Ctrl-C or --blocks N.
+
+Usage:
+    python tools/localnet.py --nodes 4 --blocks 3
+    python tools/localnet.py --nodes 4            # run until Ctrl-C
+
+Each node gets an ephemeral datadir, RPC on 9500+i, p2p on 9000+i,
+sync on 9100+i; node 0 is every later node's sync peer; all nodes find
+each other through the bootnode (PEX — no static gossip peers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+
+def _rpc(port: int, method: str, params=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request(
+        "POST", "/",
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                    "params": params or []}),
+        {"Content-Type": "application/json"},
+    )
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out.get("result")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="harmony-tpu localnet")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--blocks", type=int, default=0,
+                   help="stop after N blocks (0 = run until Ctrl-C)")
+    p.add_argument("--block-time", type=float, default=2.0)
+    p.add_argument("--keep-data", action="store_true")
+    args = p.parse_args(argv)
+
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="harmony-tpu-localnet-"))
+    procs: list[subprocess.Popen] = []
+    boot = None
+    try:
+        boot = subprocess.Popen(
+            [sys.executable, "-m", "harmony_tpu.p2p.discovery",
+             "--port", "9900"],
+            cwd=ROOT,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        print("bootnode listening on 9900")
+        for i in range(args.nodes):
+            cmd = [
+                sys.executable, "-m", "harmony_tpu.cli",
+                "--datadir", str(workdir / f"node{i}"),
+                "--rpc-port", str(9500 + i),
+                "--p2p-port", str(9000 + i),
+                "--sync-port", str(9100 + i),
+                "--metrics-port", str(9700 + i),
+                "--bootnode", "127.0.0.1:9900",
+                "--dev-key-index", str(i),
+                "--dev-keys", str(args.nodes),
+                "--skip-ntp-check",
+            ]
+            if i > 0:
+                cmd += ["--sync-peer", "127.0.0.1:9100"]
+            log = open(workdir / f"node{i}.log", "w")
+            procs.append(subprocess.Popen(
+                cmd, cwd=ROOT, stdout=log, stderr=log,
+            ))
+            print(f"node {i}: rpc :{9500 + i} p2p :{9000 + i}")
+
+        print("waiting for blocks...")
+        last = -1
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            time.sleep(2)
+            for proc in procs:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"a node exited rc={proc.returncode}; logs in "
+                        f"{workdir}"
+                    )
+            try:
+                head = _rpc(9500, "hmyv2_blockNumber")
+            except OSError:
+                continue
+            if head is not None and head != last:
+                print(f"  head = {head}")
+                last = head
+            if args.blocks and (head or 0) >= args.blocks:
+                print(f"reached {head} blocks — localnet works")
+                return 0
+        if args.blocks:
+            raise RuntimeError("timed out waiting for blocks")
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for proc in procs:
+            proc.send_signal(signal.SIGTERM)
+        if boot is not None:
+            boot.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if not args.keep_data:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"data kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
