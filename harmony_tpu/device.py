@@ -97,6 +97,29 @@ def use_device(flag: bool | None):
     _FORCED = flag
 
 
+def _probe_backend() -> bool:
+    """jax.default_backend() not in ('cpu',) — run OFF-thread with a
+    deadline: a wedged accelerator tunnel (the axon TPU transport has
+    hung backend init on this image, r1 and r3) must degrade the node
+    to the host path, not hang startup forever."""
+    result: list = []
+
+    def probe():
+        try:
+            import jax
+
+            result.append(jax.default_backend() not in ("cpu",))
+        except Exception:  # noqa: BLE001 — no jax = host only
+            result.append(False)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(float(__import__("os").environ.get("DEVICE_PROBE_S", "20")))
+    if not result:
+        return False  # probe wedged: host path (thread left to die)
+    return result[0]
+
+
 def device_enabled() -> bool:
     global _AUTO
     if _FORCED is not None:
@@ -104,12 +127,7 @@ def device_enabled() -> bool:
     if _AUTO is None:
         with _LOCK:
             if _AUTO is None:
-                try:
-                    import jax
-
-                    _AUTO = jax.default_backend() not in ("cpu",)
-                except Exception:  # noqa: BLE001 — no jax = host only
-                    _AUTO = False
+                _AUTO = _probe_backend()
     return _AUTO
 
 

@@ -77,6 +77,9 @@ def main(argv=None):
                 "--dev-key-index", str(i),
                 "--dev-keys", str(args.nodes),
                 "--skip-ntp-check",
+                # localnets verify host-side: don't let a wedged
+                # accelerator tunnel stall startup probing backends
+                "--host-verify",
             ]
             if i > 0:
                 cmd += ["--sync-peer", "127.0.0.1:9100"]
