@@ -120,3 +120,70 @@ def test_map_to_g2_is_deterministic_and_torsion():
     assert h1 == h2_
     assert g2.mul(h1, R_ORDER) is None
     assert H.map_to_g2_herumi(b"\x45" * 32) != h1
+
+
+def test_localnet_keyfile_vectors_pin_base_point():
+    """26 more herumi-PRODUCED (sk -> pk) pairs, mined from the
+    reference's encrypted localnet key files (see
+    vectors_herumi_localnet.py): each must reproduce the reference's
+    pubkey bytes exactly, independently re-pinning the BLS_SWAP_G base
+    point and the LE + parity-flag serialization."""
+    from vectors_herumi_localnet import SK_PK_VECTORS
+
+    assert len(SK_PK_VECTORS) == 26
+    for sk_hex, pk_hex in SK_PK_VECTORS:
+        sk = H.fr_from_bytes(bytes.fromhex(sk_hex))
+        assert H.g1_serialize(H.pubkey(sk)).hex() == pk_hex
+
+
+@pytest.mark.parametrize("root", ["algorithmic", "even", "odd"])
+@pytest.mark.parametrize("cofactor", ["h2", "heff"])
+def test_map_conventions_all_self_consistent(root, cofactor):
+    """Every carried (root, cofactor) convention must yield a working
+    ciphersuite: deterministic r-torsion map, sign/verify roundtrip.
+    Pinning the real mcl convention is then a config flip, not code
+    (VERDICT r3 #3a)."""
+    saved = dict(H.MAP_CONVENTION)
+    try:
+        H.set_map_convention(root=root, cofactor=cofactor)
+        msg = b"\x55" * 32
+        h = H.map_to_g2_herumi(msg)
+        assert g2.mul(h, R_ORDER) is None
+        assert H.map_to_g2_herumi(msg) == h
+        sk = H.fr_from_bytes(bytes.fromhex(SK_HEX))
+        sig = H.sign_hash(sk, msg)
+        assert H.verify_hash(H.pubkey(sk), msg, sig)
+    finally:
+        H.MAP_CONVENTION.update(saved)
+
+
+def test_map_conventions_are_distinguishable():
+    """The conventions must produce DIFFERENT signatures for at least
+    some message, so one herumi-produced vector disambiguates all of
+    them.  (A message whose map hits a y with even parity under the
+    algorithmic root makes 'algorithmic' and 'even' coincide — scan a
+    few messages so each pair is separated somewhere.)"""
+    import itertools
+
+    saved = dict(H.MAP_CONVENTION)
+    sk = H.fr_from_bytes(bytes.fromhex(SK_HEX))
+    convs = list(
+        itertools.product(["algorithmic", "even", "odd"], ["h2", "heff"])
+    )
+    separated = {}
+    try:
+        for i in range(8):
+            msg = bytes([0x60 + i]) * 32
+            sigs = {}
+            for root, cof in convs:
+                H.set_map_convention(root=root, cofactor=cof)
+                sigs[(root, cof)] = H.sign_hash(sk, msg)
+            for a, b in itertools.combinations(convs, 2):
+                if sigs[a] != sigs[b]:
+                    separated[(a, b)] = True
+            if len(separated) == len(convs) * (len(convs) - 1) // 2:
+                break
+    finally:
+        H.MAP_CONVENTION.update(saved)
+    # every pair of distinct conventions must be separated by some msg
+    assert len(separated) == len(convs) * (len(convs) - 1) // 2

@@ -1,0 +1,66 @@
+"""Staged axon-TPU tunnel probe with per-stage timing and hard watchdog.
+
+Run as a CHILD process (parent should apply a hard timeout): each stage
+appends a JSON line to stdout so a hang still leaves a partial record of
+how far init got.  Stages mirror VERDICT r3 #1: backend init, device_put,
+tiny arithmetic, then one 8-lane mont_mul (the first pairing-shaped op).
+"""
+import json, os, sys, time, faulthandler, threading
+
+def emit(stage, ok, t0, **extra):
+    rec = {"stage": stage, "ok": ok, "dt_s": round(time.time() - t0, 3)}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+def main():
+    faulthandler.register(__import__("signal").SIGUSR1)
+    # Watchdog: dump all thread stacks shortly before the parent kills us,
+    # so the hang location lands in the diagnostic bundle.
+    budget = float(os.environ.get("PROBE_BUDGET_S", "240"))
+    faulthandler.dump_traceback_later(budget - 10, exit=False, file=sys.stderr)
+
+    t0 = time.time()
+    try:
+        import jax
+        emit("import_jax", True, t0, jax_version=jax.__version__,
+             platforms_cfg=str(jax.config.jax_platforms))
+    except Exception as e:
+        emit("import_jax", False, t0, error=repr(e)); return
+
+    t0 = time.time()
+    try:
+        devs = jax.devices()
+        emit("jax_devices", True, t0, devices=[str(d) for d in devs],
+             backend=jax.default_backend())
+        if jax.default_backend() in ("cpu",):
+            emit("verdict", False, t0, reason="only-cpu-backend"); return
+    except Exception as e:
+        emit("jax_devices", False, t0, error=repr(e)[:2000]); return
+
+    t0 = time.time()
+    try:
+        import numpy as np
+        x = jax.device_put(np.arange(8, dtype=np.int32))
+        y = (x + 1).block_until_ready()
+        emit("device_put_add", True, t0, result=[int(v) for v in y])
+    except Exception as e:
+        emit("device_put_add", False, t0, error=repr(e)[:2000]); return
+
+    t0 = time.time()
+    try:
+        import numpy as np
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from harmony_tpu.ops import fp
+        from harmony_tpu.ops.limbs import int_to_limbs
+        av = np.stack([int_to_limbs(12345 + i) for i in range(8)])
+        f = jax.jit(lambda x: fp.mont_mul(fp.to_mont(x), fp.to_mont(x)))
+        r = f(av)
+        jax.block_until_ready(r)
+        emit("mont_mul_8lane", True, t0, out_limb0=int(np.asarray(r)[0, 0]))
+    except Exception as e:
+        emit("mont_mul_8lane", False, t0, error=repr(e)[:2000]); return
+
+    emit("verdict", True, t0, reason="tpu-usable")
+
+if __name__ == "__main__":
+    main()
