@@ -92,10 +92,19 @@ def decode_tx(blob: bytes) -> Transaction:
     to = f.bytes_()
     value = f.big_()
     data = f.bytes_()
+    tx_type, access_list = 0, []
+    if not f.eof():  # EIP-2930-shaped typed tail (types.py)
+        tx_type = f.int_(1)
+        if tx_type == 1:
+            for _ in range(f.int_(2)):
+                addr = f.bytes_()
+                slots = [f.bytes_() for _ in range(f.int_(2))]
+                access_list.append((addr, slots))
     return Transaction(
         nonce=nonce, gas_price=gas_price, gas_limit=gas_limit,
         shard_id=shard_id, to_shard=to_shard,
         to=(to if to else None), value=value, data=data, sig=r.bytes_(),
+        tx_type=tx_type, access_list=access_list,
     )
 
 

@@ -33,6 +33,10 @@ INTRINSIC_GAS = 21_000
 STAKING_GAS = 21_000
 DATA_GAS_NONZERO = 68
 DATA_GAS_ZERO = 4
+# EIP-2930 access-list pricing (reference: core/types AccessListTx +
+# go-ethereum params): paid in intrinsic gas, pre-warmed for EIP-2929
+ACCESS_LIST_ADDR_GAS = 2_400
+ACCESS_LIST_SLOT_GAS = 1_900
 UNDELEGATION_LOCK_EPOCHS = 7  # reference: staking undelegation maturity
 
 
@@ -44,6 +48,8 @@ def intrinsic_gas(tx: Transaction) -> int:
     g = INTRINSIC_GAS
     for b in tx.data:
         g += DATA_GAS_NONZERO if b else DATA_GAS_ZERO
+    for addr, slots in tx.access_list:
+        g += ACCESS_LIST_ADDR_GAS + ACCESS_LIST_SLOT_GAS * len(slots)
     return g
 
 
@@ -121,6 +127,12 @@ class StateProcessor:
             evm = EVM(state, env, origin=sender, gas_price=tx.gas_price)
             if tx.to is not None:
                 evm.warm_addrs.add(tx.to)  # EIP-2929: tx target warm
+            for al_addr, al_slots in tx.access_list:
+                # EIP-2930: listed entries start warm (paid above in
+                # intrinsic gas)
+                evm.warm_addrs.add(al_addr)
+                for slot in al_slots:
+                    evm.warm_slots.add((al_addr, slot))
             created = b""
             if tx.to is None:
                 # evm.create advances the nonce and derives the address

@@ -82,6 +82,12 @@ class Transaction:
     value: int
     data: bytes = b""
     sig: bytes = b""  # 65-byte [R||S||V]
+    # EIP-2930 typed transaction (reference: core/types AccessListTx):
+    # tx_type 0 = legacy (wire format unchanged), 1 = access-list tx
+    # carrying [(address20, [slot32...])]; listed entries are pre-warmed
+    # for EIP-2929 and paid for in intrinsic gas (2400/addr, 1900/slot)
+    tx_type: int = 0
+    access_list: list = field(default_factory=list)
 
     def signing_bytes(self, chain_id: int) -> bytes:
         out = bytearray()
@@ -93,6 +99,16 @@ class Transaction:
         out += _enc_bytes(self.to if self.to is not None else b"")
         out += _enc_big(self.value)
         out += _enc_bytes(self.data)
+        if self.tx_type == 1:
+            # typed envelope rides BEHIND the legacy fields so type-0
+            # signing bytes (and hashes) are byte-stable
+            out += _enc_int(1, 1)
+            out += _enc_int(len(self.access_list), 2)
+            for addr, slots in self.access_list:
+                out += _enc_bytes(addr)
+                out += _enc_int(len(slots), 2)
+                for slot in slots:
+                    out += _enc_bytes(slot)
         return bytes(out)
 
     def signing_hash(self, chain_id: int) -> bytes:

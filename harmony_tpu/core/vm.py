@@ -13,10 +13,12 @@ core/vm/interpreter.go Run + StateDB journaled snapshots).
 Gas: Istanbul-shaped constant table + quadratic memory expansion +
 EIP-2929 warm/cold access lists (behind the ``berlin`` switch, on by
 default: 2600/2100 cold account/slot, 100 warm, access lists reverted
-with their frame) + simplified SSTORE metering (set 20k / update 5k /
-clear refund 15k, plus the 2929 cold surcharge).  Documented deviation
-from the reference's exact EIP-2200 net metering: refunds capped at
-gas_used // 2.
+with their frame) + exact EIP-2200 net SSTORE metering (clean/dirty/
+no-op transitions against the tx-start original value, clear refunds
+added and unwound, restore refunds, the 2300-stipend sentry) with the
+Berlin re-pricing (reset 2900, SLOAD-like 100) when 2929 is on.
+Refunds capped at gas_used // 2 (Istanbul rule, as the reference's
+chain config uses pre-London gas policy).
 
 Precompiles 0x1-0x5, 0x9-shape: ecrecover, sha256, ripemd160,
 identity, modexp (bn256 pairing precompiles return failure — no BN254
@@ -476,6 +478,9 @@ class EVM:
         self.berlin = berlin
         self.tracer = tracer
         self.stake_msgs: list = []  # applied staking-precompile ops
+        # EIP-2200 "original" (tx-start) storage values, captured on
+        # first SSTORE touch; tx-scoped, so never reverted with frames
+        self._tx_original: dict = {}
         # EIP-2929 access lists: origin + precompiles warm at tx start
         self.warm_addrs: set = {origin} | {
             a.to_bytes(20, "big") for a in PRECOMPILES
@@ -945,26 +950,52 @@ class EVM:
                     else SLOAD_GAS
                 )
                 f.push(self.state.storage_get(address, slot))
-            elif op == 0x55:  # SSTORE
+            elif op == 0x55:  # SSTORE — exact EIP-2200 net metering
+                # (composed with EIP-2929 under berlin, as in the
+                # reference's go-ethereum fork: core/vm gas tables)
                 if static:
                     raise VMError("SSTORE in static context")
+                if f.gas <= CALL_STIPEND:
+                    # EIP-2200 sentry: never leave a reentrant call
+                    # enough gas to SSTORE out of the stipend
+                    raise VMError("SSTORE with gas <= call stipend")
                 slot = f.pop().to_bytes(32, "big")
                 v = f.pop()
                 if self.berlin:
-                    # EIP-2929: cold-slot surcharge on top of the
-                    # simplified set/update metering
                     if (address, slot) not in self.warm_slots:
                         self.warm_slots.add((address, slot))
                         f.use_gas(COLD_SLOAD)
+                key = (address, slot)
                 cur = self.state.storage_get(address, slot)
-                if cur == v:
-                    f.use_gas(WARM_ACCESS if self.berlin else SLOAD_GAS)
-                elif cur == 0:
-                    f.use_gas(SSTORE_SET)
-                else:
-                    f.use_gas(SSTORE_UPDATE)
-                    if v == 0:
-                        self.refund += SSTORE_CLEAR_REFUND
+                orig = self._tx_original.setdefault(key, cur)
+                # Berlin re-prices the EIP-2200 constants: the
+                # SLOAD-like charge becomes the warm access cost and
+                # the reset charge drops by the cold surcharge
+                sload_like = WARM_ACCESS if self.berlin else SLOAD_GAS
+                reset_gas = SSTORE_UPDATE - (
+                    COLD_SLOAD if self.berlin else 0
+                )
+                if v == cur:  # no-op write
+                    f.use_gas(sload_like)
+                elif cur == orig:  # clean slot: first real write this tx
+                    if orig == 0:
+                        f.use_gas(SSTORE_SET)
+                    else:
+                        f.use_gas(reset_gas)
+                        if v == 0:
+                            self.refund += SSTORE_CLEAR_REFUND
+                else:  # dirty slot: rewritten within this tx
+                    f.use_gas(sload_like)
+                    if orig != 0:
+                        if cur == 0:  # resurrecting: undo clear refund
+                            self.refund -= SSTORE_CLEAR_REFUND
+                        if v == 0:
+                            self.refund += SSTORE_CLEAR_REFUND
+                    if v == orig:  # restored to tx-start value
+                        if orig == 0:
+                            self.refund += SSTORE_SET - sload_like
+                        else:
+                            self.refund += reset_gas - sload_like
                 self.state.storage_set(address, slot, v)
             elif op == 0x56:  # JUMP
                 f.use_gas(8)
