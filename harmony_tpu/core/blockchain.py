@@ -250,6 +250,16 @@ class Blockchain:
             state, block.block_num, epoch,
             block.header.last_commit_bitmap or None,
         )
+        # the header's carried committee must BE the election this
+        # replay just computed (reference: VerifyShardState) — the
+        # sealed bytes are what fast-syncing nodes will trust
+        carried = block.header.shard_state
+        want = (rawdb.encode_shard_state(elected)
+                if elected is not None else b"")
+        if carried != want:
+            raise ChainError(
+                f"header shard state mismatch at block {block.block_num}"
+            )
         if self.config.state_root(state, epoch) != block.header.root:
             raise ChainError("state root mismatch after execution")
         return state, result, elected
@@ -313,6 +323,144 @@ class Blockchain:
                 )
         return seen
 
+    def _resolve_and_verify(self, blocks, commit_sigs, parent,
+                            verify_seals):
+        """Shared insert front-half (replay and fast-sync paths):
+        structural checks against ``parent``, commit-proof resolution
+        (blocks[i+1]'s carried header proof fills a None — the replay
+        pattern, sig_verify.go:37-48), and ONE batched seal
+        verification across the window.  Returns (blocks, proofs).
+        """
+        if commit_sigs is None:
+            commit_sigs = [None] * len(blocks)
+        proofs = []
+        for i, block in enumerate(blocks):
+            self._verify_structure(block, parent)
+            proof = commit_sigs[i]
+            if proof is None:
+                nxt = (blocks[i + 1].header if i + 1 < len(blocks) else None)
+                if nxt is not None and nxt.last_commit_sig:
+                    proof = nxt.last_commit_sig + nxt.last_commit_bitmap
+            proofs.append(proof)
+            parent = block.header
+
+        if verify_seals:
+            if self.engine is None:
+                raise ChainError("no engine wired; verify_seals=True")
+            items, flags = [], []
+            for block, proof in zip(blocks, proofs):
+                if proof is None:
+                    raise ChainError(
+                        f"no commit proof for block {block.block_num}"
+                    )
+                sig, bitmap = proof[:96], proof[96:]
+                items.append((block.header, sig, bitmap))
+                flags.append(self.config.is_staking(block.header.epoch))
+            ok = self.engine.verify_headers_batch(items, flags)
+            for block, good in zip(blocks, ok):
+                if not good:
+                    raise ChainError(
+                        f"bad commit signature on block {block.block_num}"
+                    )
+        return blocks, proofs
+
+    # -- fast (state) sync --------------------------------------------------
+
+    def insert_headers_fast(self, blocks: list,
+                            commit_sigs: list | None = None,
+                            verify_seals: bool = True) -> int:
+        """State-LESS insert for fast sync (reference:
+        api/service/stagedstreamsync — the blockhashes/bodies stages
+        persist verified blocks ahead of the states stage): structural
+        checks + batched seal verification + block/proof persistence,
+        WITHOUT execution and without moving the head.  The head and
+        state move together in :meth:`adopt_state` once the account
+        range download completes.  CX spent-marking for the skipped
+        range is deliberately not reconstructed — those batches were
+        consumed under consensus by the committee that sealed them.
+        """
+        if not blocks:
+            return 0
+        with self._insert_lock:
+            first = blocks[0].block_num
+            parent = self.header_by_number(first - 1)
+            if parent is None:
+                raise ChainError(f"fast insert with no parent {first - 1}")
+            # pre-resolve carried proofs from the FULL window so
+            # segmenting below can't lose a block's proof to a
+            # boundary (blocks[i+1] holds blocks[i]'s commit proof)
+            if commit_sigs is None:
+                commit_sigs = [None] * len(blocks)
+            commit_sigs = list(commit_sigs)
+            for i in range(len(blocks) - 1):
+                nxt = blocks[i + 1].header
+                if commit_sigs[i] is None and nxt.last_commit_sig:
+                    commit_sigs[i] = (
+                        nxt.last_commit_sig + nxt.last_commit_bitmap
+                    )
+            # committees chain forward through the SEALED headers:
+            # an election block (non-empty header.shard_state, sealed
+            # by the current committee) carries the next epoch's
+            # committee, so verify in segments and harvest each
+            # boundary before verifying the blocks it elects for.
+            # This is what makes fast sync trustless — no committee
+            # bytes are ever taken from a sync peer unverified
+            # (reference: stagedstreamsync + epochchain.go ShardState)
+            start = 0
+            for i, block in enumerate(blocks):
+                if not (i == len(blocks) - 1
+                        or block.header.shard_state):
+                    continue
+                seg = blocks[start:i + 1]
+                seg, proofs = self._resolve_and_verify(
+                    seg, commit_sigs[start:i + 1], parent, verify_seals
+                )
+                for b, proof in zip(seg, proofs):
+                    rawdb.write_block(self.db, b, self.config.chain_id)
+                    if proof is not None:
+                        rawdb.write_commit_sig(self.db, b.block_num, proof)
+                if block.header.shard_state:
+                    elected = rawdb.decode_shard_state(
+                        block.header.shard_state
+                    )
+                    rawdb.write_shard_state(
+                        self.db, block.header.epoch + 1, elected
+                    )
+                    self._committee_cache.pop(
+                        block.header.epoch + 1, None
+                    )
+                parent = block.header
+                start = i + 1
+            return len(blocks)
+
+    def adopt_state(self, num: int, state: StateDB) -> None:
+        """Bind a downloaded StateDB to the stored header at ``num`` and
+        move the head there — completion of the fast-sync states stage.
+        The binding check is the chain's own state commitment
+        (config.state_root: flat keccak or the epoch-gated MPT root), so
+        a peer cannot serve a forged account set: the header root was
+        already sealed by the committee's verified aggregate signature.
+        """
+        with self._insert_lock:
+            header = self.header_by_number(num)
+            if header is None:
+                raise ChainError(f"adopt_state: no header {num}")
+            if self.config.state_root(state, header.epoch) != header.root:
+                raise ChainError(
+                    "adopt_state: downloaded accounts do not match the "
+                    f"sealed state root of block {num}"
+                )
+            rawdb.write_state(self.db, header.root, state.serialize())
+            rawdb.write_head_number(self.db, num)
+            self._head_num = num
+            self._state = state
+            self._committee_cache.clear()
+
+    def write_synced_receipts(self, num: int, receipts: list) -> None:
+        """Persist receipts fetched by the fast-sync receipts stage for
+        a block in the skipped (unexecuted) range."""
+        rawdb.write_receipts(self.db, num, receipts)
+
     def insert_chain(self, blocks: list, commit_sigs: list | None = None,
                      verify_seals: bool = True) -> int:
         """Insert consecutive blocks; returns how many were inserted.
@@ -345,37 +493,9 @@ class Blockchain:
         blocks = [b for b, _ in pairs]
         commit_sigs = [s for _, s in pairs]
 
-        # structural pass + proof resolution
-        parent = self.current_header()
-        proofs = []
-        for i, block in enumerate(blocks):
-            self._verify_structure(block, parent)
-            proof = commit_sigs[i]
-            if proof is None:
-                nxt = (blocks[i + 1].header if i + 1 < len(blocks) else None)
-                if nxt is not None and nxt.last_commit_sig:
-                    proof = nxt.last_commit_sig + nxt.last_commit_bitmap
-            proofs.append(proof)
-            parent = block.header
-
-        if verify_seals:
-            if self.engine is None:
-                raise ChainError("no engine wired; verify_seals=True")
-            items, flags = [], []
-            for block, proof in zip(blocks, proofs):
-                if proof is None:
-                    raise ChainError(
-                        f"no commit proof for block {block.block_num}"
-                    )
-                sig, bitmap = proof[:96], proof[96:]
-                items.append((block.header, sig, bitmap))
-                flags.append(self.config.is_staking(block.header.epoch))
-            ok = self.engine.verify_headers_batch(items, flags)
-            for block, good in zip(blocks, ok):
-                if not good:
-                    raise ChainError(
-                        f"bad commit signature on block {block.block_num}"
-                    )
+        blocks, proofs = self._resolve_and_verify(
+            blocks, commit_sigs, self.current_header(), verify_seals
+        )
 
         # execution + persistence pass
         inserted = 0

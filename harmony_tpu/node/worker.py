@@ -13,6 +13,7 @@ leader announces.
 from __future__ import annotations
 
 from ..chain.header import Header
+from ..core import rawdb
 from ..core.state_processor import ExecutionError
 from ..core.types import Block, group_cx_by_shard, out_cx_root
 
@@ -87,7 +88,9 @@ class Worker:
         # availability finalization
         parent_proof = self.chain.read_commit_sig(parent.block_num) or b""
         last_sig, last_bitmap = parent_proof[:96], parent_proof[96:]
-        self.chain.post_process(state, num, epoch, last_bitmap or None)
+        elected = self.chain.post_process(
+            state, num, epoch, last_bitmap or None
+        )
 
         block = Block(
             None,
@@ -108,6 +111,13 @@ class Worker:
             timestamp=timestamp,
             last_commit_sig=last_sig,
             last_commit_bitmap=last_bitmap,
+            # election blocks carry the NEXT epoch's elected committee
+            # in the sealed header (reference: block header ShardState;
+            # epochchain.go reads it back) — replay verifies the bytes
+            # against its own election, and fast sync harvests verified
+            # committees from here instead of trusting sync peers
+            shard_state=(rawdb.encode_shard_state(elected)
+                         if elected is not None else b""),
             extra=leader_extra,
             vrf=vrf,
             vdf=vdf,

@@ -14,6 +14,7 @@ a method byte leading the payload.
 
 from __future__ import annotations
 
+import bisect
 import socket
 import struct
 import threading
@@ -30,7 +31,11 @@ METHOD_BLOCK_HASHES = 1    # [u64 start][u32 count] -> [hash...]
 METHOD_BLOCKS_BY_NUM = 2   # [u64 start][u32 count] -> [block blob...]
 METHOD_HEAD = 3            # [] -> [u64 head][32B hash]
 METHOD_EPOCH_STATE = 4     # [u64 epoch] -> [encoded shard state | empty]
-MAX_BLOCKS_PER_REQUEST = 128  # server-side clamp
+METHOD_RECEIPTS = 5        # [u64 start][u32 count] -> per-block receipt blobs
+METHOD_ACCOUNT_RANGE = 6   # [u64 block][len-pfx start addr][u32 limit]
+#                            -> [u32 n][(addr, account blob)...]
+MAX_BLOCKS_PER_REQUEST = 128   # server-side clamp
+MAX_ACCOUNTS_PER_REQUEST = 512  # account-range clamp
 
 
 def protocol_id(network: str, shard_id: int) -> str:
@@ -53,6 +58,12 @@ class SyncServer:
 
         self.chain = chain
         self.limiter = RateLimiter(rate_per_sec, burst)
+        # account-range paging cache: one (block num -> sorted account
+        # items) entry, so a full state download costs ONE state
+        # deserialize + sort instead of one per page (O(N) not
+        # O(N^2/limit) in account count)
+        self._range_cache: tuple | None = None
+        self._range_lock = threading.Lock()
         self._closing = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -110,6 +121,38 @@ class SyncServer:
             if state is None:
                 return b""
             return rawdb.encode_shard_state(state)
+        if method == METHOD_ACCOUNT_RANGE:
+            # snap-style state serving (reference: p2p/stream sync
+            # client.go GetAccountRange): sorted accounts of the state
+            # at a given block, strictly after ``start``, paged by
+            # ``limit`` — the fast-sync states stage reads these.
+            num = r.int_()
+            start_addr = r.bytes_()
+            limit = min(r.int_(4), MAX_ACCOUNTS_PER_REQUEST)
+            with self._range_lock:
+                if self._range_cache and self._range_cache[0] == num:
+                    _, keys, everything = self._range_cache
+                else:
+                    try:
+                        state = self.chain.state_at(num)
+                    except Exception:  # noqa: BLE001 — peer lacks the
+                        # state (e.g. it fast-synced itself); the count
+                        # sentinel is distinct from a legitimate empty
+                        # page so the client moves on to another peer
+                        # instead of adopting nothing
+                        return _enc_int(0xFFFFFFFF, 4)
+                    everything = [
+                        (addr, acct.encode())
+                        for addr, acct in state._live_accounts()
+                    ]
+                    keys = [a for a, _ in everything]
+                    self._range_cache = (num, keys, everything)
+            lo = bisect.bisect_right(keys, start_addr)
+            items = everything[lo:lo + limit]
+            out = bytearray(_enc_int(len(items), 4))
+            for addr, blob in items:
+                out += _enc_bytes(addr) + _enc_bytes(blob)
+            return bytes(out)
         start = r.int_()
         count = min(r.int_(4), MAX_BLOCKS_PER_REQUEST)
         if method == METHOD_BLOCK_HASHES:
@@ -119,6 +162,22 @@ class SyncServer:
                 if h is None:
                     break
                 out += h
+            return bytes(out)
+        if method == METHOD_RECEIPTS:
+            # per-block receipt lists (reference: client.go GetReceipts
+            # feeding the stagedstreamsync receipts stage)
+            blobs = []
+            for num in range(start, start + count):
+                if num > self.chain.head_number:
+                    break
+                receipts = rawdb.read_receipts(self.chain.db, num)
+                blob = bytearray(_enc_int(len(receipts), 4))
+                for rc in receipts:
+                    blob += rc.encode()
+                blobs.append(bytes(blob))
+            out = bytearray(_enc_int(len(blobs), 4))
+            for blob in blobs:
+                out += _enc_bytes(blob)
             return bytes(out)
         if method == METHOD_BLOCKS_BY_NUM:
             out = bytearray()
@@ -206,6 +265,35 @@ class SyncClient:
                 (Block(header, txs, stxs, cxs, order), sig or None)
             )
         return out
+
+    def get_receipts(self, start: int, count: int) -> list:
+        """[[Receipt]] — one list per block from ``start``."""
+        from ..core.types import Receipt
+
+        resp = self._call(
+            bytes([METHOD_RECEIPTS])
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+        )
+        r = _Reader(resp)
+        out = []
+        for _ in range(r.int_(4)):
+            item = _Reader(r.bytes_())
+            out.append([Receipt.decode(item) for _ in range(item.int_(4))])
+        return out
+
+    def get_account_range(self, num: int, start_addr: bytes = b"",
+                          limit: int = MAX_ACCOUNTS_PER_REQUEST) -> list:
+        """[(addr, account blob)] of the remote state at block ``num``,
+        strictly after ``start_addr``; page until a short page."""
+        resp = self._call(
+            bytes([METHOD_ACCOUNT_RANGE]) + num.to_bytes(8, "little")
+            + _enc_bytes(start_addr) + limit.to_bytes(4, "little")
+        )
+        r = _Reader(resp)
+        n = r.int_(4)
+        if n == 0xFFFFFFFF:
+            raise ConnectionError(f"peer has no state at block {num}")
+        return [(r.bytes_(), r.bytes_()) for _ in range(n)]
 
     def get_epoch_state(self, epoch: int):
         """The elected shard State recorded for ``epoch`` on the remote

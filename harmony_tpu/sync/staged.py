@@ -99,6 +99,100 @@ class Downloader:
                 return items
         return []
 
+    # -- stages: fast (state) sync -----------------------------------------
+
+    def _download_state(self, num: int):
+        """Account-range paging (reference: client.go GetAccountRange →
+        the states stage): assemble the full flat account set of the
+        remote state at block ``num``."""
+        from ..core.state import StateDB, _decode_account
+
+        accounts = {}
+        for c in self.clients:
+            try:
+                start = b""
+                while True:
+                    page = c.get_account_range(num, start)
+                    for addr, blob in page:
+                        accounts[addr] = _decode_account(blob)
+                    if not page:
+                        break
+                    start = page[-1][0]
+                return StateDB(accounts)
+            except (ConnectionError, OSError):
+                accounts.clear()
+                continue
+        return None
+
+    def fast_sync(self, receipts_tail: int = BATCH) -> SyncResult:
+        """Join at the head WITHOUT replaying execution (reference:
+        api/service/stagedstreamsync default_stages.go — heads →
+        hashes → bodies → states → receipts): download seal-verified
+        blocks, then the account set of the head state (bound to the
+        sealed state root in adopt_state), then receipts for the
+        recent tail so tx-facing RPCs answer."""
+        res = SyncResult(target=self.network_head())
+        head = self.chain.head_number
+        if res.target <= head:
+            return res
+        _log.info("fast sync start", head=head, target=res.target)
+        # stage: bodies (state-less, seal-verified, head unmoved).
+        # Committees are NOT fetched from peers: insert_headers_fast
+        # harvests each next epoch's committee from the sealed
+        # election headers themselves, so the seal-verification trust
+        # chain runs unbroken from the local head to the target
+        # (a peer serving forged epoch states cannot influence it)
+        num = head + 1
+        last_inserted = head
+        while num <= res.target:
+            count = min(self.batch, res.target - num + 1)
+            hashes = self.agreed_hashes(num, count)
+            if not hashes:
+                res.errors.append(f"no hash agreement at {num}")
+                return res
+            items = self._fetch_window(num, len(hashes), hashes)
+            if not items:
+                res.errors.append(f"no peer served window at {num}")
+                return res
+            try:
+                self.chain.insert_headers_fast(
+                    [b for b, _ in items], [s for _, s in items],
+                    verify_seals=self.verify_seals,
+                )
+            except ValueError as e:
+                res.errors.append(f"fast insert failed at {num}: {e}")
+                return res
+            last_inserted = items[-1][0].block_num
+            num = last_inserted + 1
+        # stage: states — bind the downloaded accounts to the sealed root
+        state = self._download_state(last_inserted)
+        if state is None:
+            res.errors.append("no peer served the account range")
+            return res
+        try:
+            self.chain.adopt_state(last_inserted, state)
+        except ValueError as e:
+            res.errors.append(f"state adoption failed: {e}")
+            return res
+        res.inserted = last_inserted - head
+        # stage: receipts — recent tail only (older blocks stay
+        # header-only, as after a snap sync)
+        lo = max(head + 1, last_inserted - receipts_tail + 1)
+        for c in self.clients:
+            try:
+                per_block = c.get_receipts(lo, last_inserted - lo + 1)
+            except (ConnectionError, OSError):
+                continue
+            for i, receipts in enumerate(per_block):
+                if receipts:
+                    self.chain.write_synced_receipts(lo + i, receipts)
+            break
+        _log.info(
+            "fast sync done", head=self.chain.head_number,
+            inserted=res.inserted,
+        )
+        return res
+
     def sync_once(self) -> SyncResult:
         """One pass to the current network head."""
         res = SyncResult(target=self.network_head())

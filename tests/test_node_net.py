@@ -128,6 +128,163 @@ def test_sync_stream_and_staged_download():
         srv.close()
 
 
+def test_fast_sync_joins_head_without_replay():
+    """VERDICT r3 #6: a node with EMPTY state reaches the head through
+    the states stage (account-range download bound to the sealed state
+    root) instead of replaying every block; receipts for the recent
+    tail arrive via METHOD_RECEIPTS."""
+    serving, genesis = _chain_with_blocks(5)
+    srv = SyncServer(serving)
+    try:
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(fresh, [SyncClient(srv.port)], batch=2,
+                        verify_seals=False)
+        # canary: fast_sync must NOT execute transactions on the fresh
+        # chain — make replay impossible by poisoning the processor
+        dl.chain.processor = None
+        res = dl.fast_sync(receipts_tail=2)
+        assert res.inserted == 5 and not res.errors
+        assert fresh.head_number == 5
+        assert fresh.current_header().hash() == (
+            serving.current_header().hash()
+        )
+        assert fresh.state().root() == serving.state().root()
+        # the receipts tail (blocks 4-5) was fetched and indexed
+        from harmony_tpu.core import rawdb
+
+        assert rawdb.read_receipts(fresh.db, 5)
+        assert [r.tx_hash for r in rawdb.read_receipts(fresh.db, 5)] == [
+            r.tx_hash for r in rawdb.read_receipts(serving.db, 5)
+        ]
+        # a fast-synced node keeps extending normally (processor back)
+        from harmony_tpu.core.state_processor import StateProcessor
+
+        fresh.processor = StateProcessor(CHAIN_ID, 0)
+    finally:
+        srv.close()
+
+
+def test_fast_sync_harvests_committees_from_sealed_headers():
+    """The fast-sync trust chain across an election (VERDICT r3 #6 +
+    review hardening): the next epoch's committee is read from the
+    seal-verified election HEADER (header.shard_state, written by the
+    proposer and replay-verified), never from a peer's epoch-state
+    blob — a peer serving forged epoch states cannot influence seal
+    verification.  Reference: block header ShardState + epochchain.go;
+    stagedstreamsync."""
+    from harmony_tpu.chain.engine import Engine, EpochContext
+    from harmony_tpu.chain.finalize import FinalizeConfig, Finalizer
+    from harmony_tpu.consensus.mask import Mask
+    from harmony_tpu.consensus.signature import construct_commit_payload
+
+    genesis, ecdsa_keys, bls_keys = dev_genesis()
+
+    def _mk_chain():
+        fin = Finalizer(FinalizeConfig(
+            block_reward=28 * 10**18,
+            shard_count=1,
+            external_slots_per_shard=2,
+            harmony_accounts=[
+                (k.address(), pub)
+                for k, pub in zip(ecdsa_keys, genesis.committee)
+            ],
+        ))
+        chain = Blockchain(MemKV(), genesis, blocks_per_epoch=4,
+                           finalizer=fin)
+        chain.engine = Engine(
+            lambda shard, epoch: EpochContext(
+                chain.committee_for_epoch(epoch)
+            ),
+            device=False,
+        )
+        return chain
+
+    def _proof(header):
+        payload = construct_commit_payload(
+            header.hash(), header.block_num, header.view_id, True
+        )
+        sigs = [k.sign_hash(payload) for k in bls_keys]
+        agg = B.aggregate_sigs(sigs)
+        mask = Mask([k.pub.point for k in bls_keys])
+        for i in range(len(bls_keys)):
+            mask.set_bit(i, True)
+        return agg.bytes + mask.mask_bytes()
+
+    serving = _mk_chain()
+    worker = Worker(serving, None)
+    for i in range(5):  # block 3 is the election block (BPE=4)
+        block = worker.propose_block(view_id=i + 1)
+        serving.insert_chain([block], verify_seals=False)
+        serving.write_commit_sig(block.block_num, _proof(block.header))
+    assert serving.header_by_number(3).shard_state  # committee carried
+
+    srv = SyncServer(serving)
+    try:
+        fresh = _mk_chain()
+        client = SyncClient(srv.port)
+        # poison the epoch-state RPC: the trustless path must not ask
+        client.get_epoch_state = None
+        dl = Downloader(fresh, [client], batch=2, verify_seals=True)
+        res = dl.fast_sync(receipts_tail=1)
+        assert res.inserted == 5 and not res.errors, res.errors
+        assert fresh.head_number == 5
+        assert fresh.state().root() == serving.state().root()
+        # the epoch-1 committee came from the sealed election header
+        assert fresh.committee_for_epoch(1) == (
+            serving.committee_for_epoch(1)
+        )
+        # a corrupted seal in the window is rejected outright
+        fresh2 = _mk_chain()
+        import harmony_tpu.core.rawdb as rawdb_mod
+
+        blob = serving.read_commit_sig(2)
+        serving.write_commit_sig(2, blob[:10] + b"\x00" * 86 + blob[96:])
+        dl2 = Downloader(fresh2, [SyncClient(srv.port)], batch=5,
+                         verify_seals=True)
+        res2 = dl2.fast_sync()
+        assert res2.errors and fresh2.head_number == 0
+        serving.write_commit_sig(2, blob)  # restore
+    finally:
+        srv.close()
+
+
+def test_adopt_state_rejects_forged_accounts():
+    """adopt_state is the trust boundary of the states stage: accounts
+    that do not hash to the sealed state root must be rejected."""
+    from harmony_tpu.core.blockchain import ChainError
+    from harmony_tpu.core.state import StateDB
+
+    serving, genesis = _chain_with_blocks(2)
+    forged = StateDB({b"\x07" * 20: serving.state().account(b"\x07" * 20)})
+    forged.add_balance(b"\x07" * 20, 10**18)
+    with pytest.raises(ChainError):
+        serving.adopt_state(2, forged)
+
+
+def test_account_range_pagination_covers_state():
+    serving, _ = _chain_with_blocks(3)
+    srv = SyncServer(serving)
+    try:
+        cli = SyncClient(srv.port)
+        # page size 2 forces multiple round trips
+        start, got = b"", []
+        while True:
+            page = cli.get_account_range(3, start, limit=2)
+            got.extend(page)
+            if not page:
+                break
+            start = page[-1][0]
+        addrs = [a for a, _ in got]
+        assert addrs == sorted(addrs)
+        assert len(addrs) == len(set(addrs))
+        live = dict(serving.state_at(3)._live_accounts())
+        assert set(addrs) == set(live)
+        for addr, blob in got:
+            assert blob == live[addr].encode()
+    finally:
+        srv.close()
+
+
 # -- service manager --------------------------------------------------------
 
 class _SpySvc(Service):
