@@ -41,6 +41,7 @@ from .sync import Downloader
 DEFAULTS = {
     "network": "localnet",
     "shard_id": 0,
+    "shard_count": 1,  # >1 arms live cross-shard receipt routing
     "datadir": "./harmony_tpu_data",
     "blocks_per_epoch": 32768,
     "rpc_port": 9500,
@@ -109,8 +110,17 @@ def _open_genesis(cfg: dict):
         shard_id=cfg["shard_id"],
     )
     if cfg.get("dev_key_index") is not None:
-        # multi-process localnet: each node holds ONE dev key
-        dev_bls = [dev_bls[int(cfg["dev_key_index"])]]
+        # multi-process localnet: this node votes with a SPAN of
+        # consecutive dev keys (span > 1 = a multi-BLS validator,
+        # reference: multibls/multibls.go)
+        i = int(cfg["dev_key_index"])
+        span = int(cfg.get("dev_key_span") or 1)
+        if i < 0 or span < 1 or i + span > len(dev_bls):
+            raise SystemExit(
+                f"dev key span [{i}, {i + span}) out of range for "
+                f"{len(dev_bls)} dev keys"
+            )
+        dev_bls = dev_bls[i:i + span]
     return genesis, dev_bls
 
 
@@ -247,6 +257,7 @@ def build_node(cfg: dict):
         reg.set("discovery", discovery)
     if reg_epoch_chain is not None:
         reg.set("beaconchain", reg_epoch_chain)
+    reg.set("shard_count", int(cfg.get("shard_count") or 1))
     node = Node(reg, keys, network=cfg["network"])
     hmy = Harmony(chain, pool, node)
 
@@ -364,7 +375,10 @@ def build_node(cfg: dict):
     manager.register(
         ServiceType.CONSENSUS,
         _CallbackService(
-            lambda: consensus_thread.append(node.run_forever()),
+            lambda: consensus_thread.append(node.run_forever(
+                block_time=float(cfg.get("block_time") or 2.0),
+                phase_timeout=cfg.get("phase_timeout"),
+            )),
             node.stop,
         ),
     )
@@ -376,6 +390,14 @@ def main(argv=None):
     p.add_argument("--config", help="TOML config file")
     p.add_argument("--network")
     p.add_argument("--shard-id", type=int, dest="shard_id")
+    p.add_argument("--shard-count", type=int, dest="shard_count")
+    p.add_argument("--block-time", type=float, dest="block_time")
+    p.add_argument("--phase-timeout", type=float, dest="phase_timeout",
+                   help="consensus phase timeout before view change "
+                        "(default: the reference's 27 s)")
+    p.add_argument("--dev-key-span", type=int, dest="dev_key_span",
+                   help="number of consecutive dev keys this node votes "
+                        "with (multi-BLS validator)")
     p.add_argument("--datadir")
     p.add_argument("--rpc-port", type=int, dest="rpc_port")
     p.add_argument("--metrics-port", type=int, dest="metrics_port")

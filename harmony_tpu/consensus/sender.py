@@ -12,8 +12,14 @@ import threading
 
 
 class MessageSender:
-    RETRY_INTERVAL = 1.0  # seconds between re-publishes
-    MAX_RETRIES = 10
+    # 10 fast re-publishes, then a slow tail: a proposal must outlive
+    # mesh FORMATION (a fresh localnet's PEX rounds take tens of
+    # seconds), not just a dropped packet.  ~70 s of coverage total;
+    # stop_retry / supersession bound the traffic as before.
+    RETRY_INTERVAL = 1.0   # seconds between the first re-publishes
+    SLOW_INTERVAL = 5.0    # tail interval after the fast burst
+    FAST_RETRIES = 10
+    MAX_RETRIES = 22
 
     def __init__(self, host, topics: list):
         self.host = host
@@ -36,8 +42,10 @@ class MessageSender:
         self.host.publish_to_groups(self.topics, payload)
 
         def loop():
-            for _ in range(self.MAX_RETRIES):
-                if cancel.wait(self.RETRY_INTERVAL):
+            for i in range(self.MAX_RETRIES):
+                wait = (self.RETRY_INTERVAL if i < self.FAST_RETRIES
+                        else self.SLOW_INTERVAL)
+                if cancel.wait(wait):
                     return
                 self.host.publish_to_groups(self.topics, payload)
 

@@ -107,6 +107,31 @@ class Node:
         self.log = get_logger("consensus", shard=self.chain.shard_id)
         self.host.add_validator(self.topic, self._gossip_validator)
         self.host.subscribe(self.topic, self._on_gossip)
+        # live cross-shard receipt routing (reference:
+        # node_cross_shard.go BroadcastCXReceipts / ProcessReceiptMessage):
+        # in a multi-shard topology each committed block's outgoing
+        # receipts are exported as sealed proofs to the destination
+        # shards' cx topics; incoming proofs are verified into the
+        # CXPool and drained into this node's next proposal
+        self.shard_count = int(registry.get("shard_count") or 1)
+        self.cx_pool = None
+        if self.shard_count > 1:
+            from ..core import rawdb as _rawdb
+            from .cross_shard import CXPool, cx_topic
+
+            self.cx_pool = CXPool(
+                self.chain.shard_id,
+                engine=self.chain.engine,
+                config=self.chain.config,
+                spent=lambda fs, n: _rawdb.is_cx_spent(
+                    self.chain.db, fs, n
+                ),
+            )
+            self._cx_topic = cx_topic(network, self.chain.shard_id)
+            self.host.subscribe(
+                self._cx_topic,
+                lambda _t, payload, _f: self.cx_pool.add_batch(payload),
+            )
         self._new_round()
 
     # -- committee / role ---------------------------------------------------
@@ -253,7 +278,10 @@ class Node:
                     self.keys[0], self.chain.current_header().hash()
                 )
                 vrf = proof
-            block = self.worker.propose_block(view_id=self.view_id, vrf=vrf)
+            incoming = self.cx_pool.drain() if self.cx_pool else None
+            block = self.worker.propose_block(
+                view_id=self.view_id, vrf=vrf, incoming_receipts=incoming
+            )
         block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
         self._pending_block = block
         self._proposed = True
@@ -461,15 +489,27 @@ class Node:
         # committee member must not be able to pick a view id whose
         # rotation lands on itself (leader capture)
         if msg.view_id != self.view_id:
+            self.log.debug(
+                "announce dropped: view mismatch", msg_view=msg.view_id,
+                our_view=self.view_id, block=msg.block_num,
+            )
             return
         if not msg.sender_pubkeys or (
             msg.sender_pubkeys[0] != self._round_leader_key
         ):
+            self.log.debug(
+                "announce dropped: not this view's leader",
+                view=self.view_id, block=msg.block_num,
+            )
             return
         if self._announce_voted == (msg.block_num, self.view_id):
             return  # already prepared a block this round
         block = self._validate_proposed_block(msg.block)
         if block is None:
+            self.log.warn(
+                "announce dropped: proposal failed validation",
+                block=msg.block_num, view=self.view_id,
+            )
             return
         self._pending_block = block
         self._announce_voted = (msg.block_num, self.view_id)
@@ -478,6 +518,9 @@ class Node:
         self.validator.cfg.payload_view_id = block.header.view_id
         vote = self.validator.on_announce(msg)
         self._broadcast(vote)
+        self.log.info(
+            "prepare vote sent", block=msg.block_num, view=self.view_id,
+        )
 
     def _leader_advance(self):
         """Emit PREPARED/COMMITTED the moment their quorum holds for the
@@ -564,7 +607,12 @@ class Node:
     def _on_prepare(self, msg: FBFTMessage):
         if not self.is_leader:
             return
-        if not self.leader.on_prepare(msg):
+        if self.leader.on_prepare(msg):
+            self.log.info(
+                "prepare vote counted", block=self.block_num,
+                view=self.view_id, keys=len(self.leader.prepare_sigs),
+            )
+        else:
             from ..consensus.signature import prepare_payload
 
             self._check_double_sign(
@@ -627,6 +675,13 @@ class Node:
         if self.pool is not None:
             self.pool.drop_applied()
         self.sender.stop_retry(block.block_num)
+        if self.shard_count > 1 and self.is_leader:
+            # sender-side restricted, as the reference's
+            # BroadcastCXReceipts: one exporter per committed block
+            # keeps destination-shard decode work O(1) in committee
+            # size (every validator CAN export — hmy facade reads —
+            # but only the round's leader publishes)
+            self._broadcast_cx_receipts(block.block_num)
         self.committed_blocks += 1
         self._vc = 0
         self._sent_prepared = False
@@ -643,6 +698,51 @@ class Node:
             and time.monotonic() - self._last_propose >= self.block_time
         ):
             self.start_round_if_leader()
+
+    def _broadcast_cx_receipts(self, block_num: int):
+        """Export the committed block's outgoing receipts as sealed
+        proofs and publish each to its destination shard's cx topic
+        (reference: node_cross_shard.go BroadcastCXReceipts).
+
+        The publish is re-fired on a backoff tail (like the consensus
+        sender's retry): destination-side CXPool dedup makes repeats
+        free, and a one-shot publish would lose the transfer forever
+        to a still-forming mesh.  Residual risk — the leader dying
+        within the retry window — is recoverable by any validator
+        re-exporting via the same rawdb batch (hmy facade surface)."""
+        from .cross_shard import cx_topic, encode_cx_batch, export_receipts
+
+        try:
+            proofs = export_receipts(
+                self.chain, block_num, self.shard_count
+            )
+        except (ValueError, KeyError) as e:
+            self.log.warn("cx export failed", block=block_num, err=str(e))
+            return
+        wires = {
+            to_shard: encode_cx_batch(proof)
+            for to_shard, proof in proofs.items()
+        }
+        for to_shard, proof in proofs.items():
+            self.host.publish(cx_topic(self.network, to_shard),
+                              wires[to_shard])
+            self.log.info(
+                "cx receipts exported", block=block_num,
+                to_shard=to_shard, n=len(proof.receipts),
+            )
+        if not wires:
+            return
+
+        def retry_tail():
+            for wait in (2.0, 5.0, 10.0, 20.0, 30.0):
+                if self._stop.wait(wait):
+                    return
+                for to_shard, wire in wires.items():
+                    self.host.publish(
+                        cx_topic(self.network, to_shard), wire
+                    )
+
+        threading.Thread(target=retry_tail, daemon=True).start()
 
     # -- view change (reference: consensus/view_change.go:220-553) ----------
 
@@ -790,12 +890,19 @@ class Node:
     # -- live mode ----------------------------------------------------------
 
     def run_forever(self, poll_interval: float = 0.01,
-                    block_time: float = 2.0):
+                    block_time: float = 2.0,
+                    phase_timeout: float | None = None):
         """Drive the pump; the leader proposes at most every
         ``block_time`` seconds (reference: mainnet 2 s block period,
-        internal/params/config.go:740 IsTwoSeconds)."""
+        internal/params/config.go:740 IsTwoSeconds).  ``phase_timeout``
+        overrides the 27 s reference default (consensus/config.go:10) —
+        oversubscribed localnets (N python processes on one core doing
+        host-bigint pairing checks) need room, a real deployment does
+        not."""
 
         self.block_time = block_time
+        if phase_timeout is not None:
+            self.phase_timeout = float(phase_timeout)
         self.pipelining = True  # live mode: overlap COMMITTED + propose
 
         def loop():
@@ -812,6 +919,16 @@ class Node:
                     # reference restarts VC with growing timeouts — a
                     # dead next-leader must not wedge the network)
                     self.start_view_change()
+                    if self._vc >= 2:
+                        # two VC timeouts without a commit: either the
+                        # network is dead (sync is a no-op) or it moved
+                        # on without us — e.g. we missed COMMITTED for a
+                        # round we prepared.  Probing peers' heads does
+                        # not depend on gossip reaching us, so this
+                        # recovers wedges the _ahead_runs counter can't
+                        # see (the reference's consensus-timeout sync,
+                        # consensus/downloader.go + view change spin)
+                        self._spin_up_sync()
                 if not self.process_pending():
                     self._stop.wait(poll_interval)
 

@@ -5,13 +5,23 @@ localnet tier of SURVEY §4): spawn a bootnode and one process per
 validator, wire discovery + sync peers, wait for blocks to flow, and
 tear everything down on Ctrl-C or --blocks N.
 
-Usage:
-    python tools/localnet.py --nodes 4 --blocks 3
-    python tools/localnet.py --nodes 4            # run until Ctrl-C
+Round-4 scenarios (VERDICT r3 #4):
+  --multikey M        first M nodes vote with TWO consecutive dev keys
+                      (multi-BLS validators, reference: multibls)
+  --kill-leader-at B  at shard-0 head B, SIGKILL node 0; the run then
+                      requires the chain to keep committing through a
+                      full leader-rotation cycle and at least one
+                      "adopt new view" in a survivor's log (view change
+                      completed)
+  --shards S          S committees (S*nodes processes); with
+                      --cross-shard a shard-0 -> shard-1 transfer is
+                      submitted over RPC and must land as balance on
+                      shard 1 (live CXReceiptsProof routing over TCP)
 
-Each node gets an ephemeral datadir, RPC on 9500+i, p2p on 9000+i,
-sync on 9100+i; node 0 is every later node's sync peer; all nodes find
-each other through the bootnode (PEX — no static gossip peers).
+Usage:
+    python tools/localnet.py --nodes 8 --blocks 6 --multikey 2
+    python tools/localnet.py --nodes 8 --blocks 5 --kill-leader-at 2
+    python tools/localnet.py --nodes 3 --shards 2 --cross-shard --blocks 8
 """
 
 from __future__ import annotations
@@ -31,8 +41,8 @@ import time
 ROOT = pathlib.Path(__file__).parent.parent
 
 
-def _rpc(port: int, method: str, params=None):
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+def _rpc(port: int, method: str, params=None, timeout: float = 5):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     conn.request(
         "POST", "/",
         json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
@@ -44,91 +54,273 @@ def _rpc(port: int, method: str, params=None):
     return out.get("result")
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser(description="harmony-tpu localnet")
-    p.add_argument("--nodes", type=int, default=4)
-    p.add_argument("--blocks", type=int, default=0,
-                   help="stop after N blocks (0 = run until Ctrl-C)")
-    p.add_argument("--block-time", type=float, default=2.0)
-    p.add_argument("--keep-data", action="store_true")
-    args = p.parse_args(argv)
+class Net:
+    """Process supervisor for one localnet run."""
 
-    workdir = pathlib.Path(tempfile.mkdtemp(prefix="harmony-tpu-localnet-"))
-    procs: list[subprocess.Popen] = []
-    boot = None
-    try:
-        boot = subprocess.Popen(
+    def __init__(self, args, workdir: pathlib.Path):
+        self.args = args
+        self.workdir = workdir
+        self.procs: dict[tuple[int, int], subprocess.Popen] = {}
+        self.boot: subprocess.Popen | None = None
+        # key layout per shard: first --multikey nodes take 2 keys each
+        self.spans = [
+            2 if i < args.multikey else 1 for i in range(args.nodes)
+        ]
+        self.total_keys = sum(self.spans)
+
+    def rpc_port(self, shard: int, i: int) -> int:
+        return 9500 + shard * self.args.nodes + i
+
+    def start(self):
+        self.boot = subprocess.Popen(
             [sys.executable, "-m", "harmony_tpu.p2p.discovery",
              "--port", "9900"],
-            cwd=ROOT,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            cwd=ROOT, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
-        print("bootnode listening on 9900")
-        for i in range(args.nodes):
-            cmd = [
-                sys.executable, "-m", "harmony_tpu.cli",
-                "--datadir", str(workdir / f"node{i}"),
-                "--rpc-port", str(9500 + i),
-                "--p2p-port", str(9000 + i),
-                "--sync-port", str(9100 + i),
-                "--metrics-port", str(9700 + i),
-                "--bootnode", "127.0.0.1:9900",
-                "--dev-key-index", str(i),
-                "--dev-keys", str(args.nodes),
-                "--skip-ntp-check",
-                # localnets verify host-side: don't let a wedged
-                # accelerator tunnel stall startup probing backends
-                "--host-verify",
-            ]
-            if i > 0:
-                cmd += ["--sync-peer", "127.0.0.1:9100"]
-            log = open(workdir / f"node{i}.log", "w")
-            procs.append(subprocess.Popen(
-                cmd, cwd=ROOT, stdout=log, stderr=log,
-            ))
-            print(f"node {i}: rpc :{9500 + i} p2p :{9000 + i}")
+        print(f"bootnode :9900; {self.args.shards} shard(s) x "
+              f"{self.args.nodes} nodes, {self.total_keys} keys/committee, "
+              f"{self.args.multikey} multi-key validators")
+        for s in range(self.args.shards):
+            for i in range(self.args.nodes):
+                self.spawn(s, i)
 
-        print("waiting for blocks...")
-        last = -1
-        deadline = time.monotonic() + 600
-        while time.monotonic() < deadline:
-            time.sleep(2)
-            for proc in procs:
-                if proc.poll() is not None:
-                    raise RuntimeError(
-                        f"a node exited rc={proc.returncode}; logs in "
-                        f"{workdir}"
-                    )
+    def spawn(self, shard: int, i: int):
+        g = shard * self.args.nodes + i
+        key_index = sum(self.spans[:i])
+        cmd = [
+            sys.executable, "-m", "harmony_tpu.cli",
+            "--datadir", str(self.workdir / f"s{shard}n{i}"),
+            "--rpc-port", str(9500 + g),
+            "--p2p-port", str(9000 + g),
+            "--sync-port", str(9100 + g),
+            "--metrics-port", str(9700 + g),
+            "--bootnode", "127.0.0.1:9900",
+            "--shard-id", str(shard),
+            "--shard-count", str(self.args.shards),
+            "--dev-key-index", str(key_index),
+            "--dev-key-span", str(self.spans[i]),
+            "--dev-keys", str(self.total_keys),
+            "--block-time", str(self.args.block_time),
+            "--phase-timeout", str(self.args.phase_timeout),
+            "--skip-ntp-check",
+            # localnets verify host-side: don't let a wedged
+            # accelerator tunnel stall startup probing backends
+            "--host-verify",
+        ]
+        # every node can pull from a neighbour — node 0 included: a
+        # node that misses a COMMITTED message recovers via the
+        # consensus-timeout sync path, which needs a stream peer
+        peer = (i + 1) % self.args.nodes
+        cmd += ["--sync-peer",
+                f"127.0.0.1:{9100 + shard * self.args.nodes + peer}"]
+        if shard > 0:
+            cmd += ["--beacon-sync-peer", "127.0.0.1:9100"]
+        log = open(self.workdir / f"s{shard}n{i}.log", "w")
+        self.procs[(shard, i)] = subprocess.Popen(
+            cmd, cwd=ROOT, stdout=log, stderr=log,
+        )
+        print(f"  shard {shard} node {i}: rpc :{9500 + g} "
+              f"keys {key_index}..{key_index + self.spans[i] - 1}")
+
+    def kill(self, shard: int, i: int):
+        proc = self.procs.pop((shard, i))
+        proc.kill()
+        proc.wait(5)
+        print(f"  KILLED shard {shard} node {i} (pid {proc.pid})")
+
+    def alive_rpc_ports(self, shard: int):
+        return [self.rpc_port(s, i) for (s, i) in self.procs
+                if s == shard]
+
+    def head(self, shard: int):
+        """Network head = max over responding nodes (a lagging or
+        resyncing node must not mask the committee's progress)."""
+        best = None
+        for port in self.alive_rpc_ports(shard):
             try:
-                head = _rpc(9500, "hmyv2_blockNumber")
+                h = _rpc(port, "hmyv2_blockNumber")
             except OSError:
                 continue
-            if head is not None and head != last:
-                print(f"  head = {head}")
-                last = head
-            if args.blocks and (head or 0) >= args.blocks:
-                print(f"reached {head} blocks — localnet works")
-                return 0
-        if args.blocks:
-            raise RuntimeError("timed out waiting for blocks")
-        return 0
-    except KeyboardInterrupt:
-        return 0
-    finally:
-        for proc in procs:
+            if h is not None and (best is None or h > best):
+                best = h
+        return best
+
+    def check_alive(self):
+        for (s, i), proc in self.procs.items():
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"shard {s} node {i} exited rc={proc.returncode}; "
+                    f"logs in {self.workdir}"
+                )
+
+    def grep_logs(self, needle: str, shard: int = 0) -> int:
+        hits = 0
+        for (s, i) in self.procs:
+            if s != shard:
+                continue
+            path = self.workdir / f"s{s}n{i}.log"
+            try:
+                hits += open(path, errors="replace").read().count(needle)
+            except OSError:
+                pass
+        return hits
+
+    def stop(self):
+        for proc in self.procs.values():
             proc.send_signal(signal.SIGTERM)
-        if boot is not None:
-            boot.send_signal(signal.SIGTERM)
-        for proc in procs:
+        if self.boot is not None:
+            self.boot.send_signal(signal.SIGTERM)
+        for proc in self.procs.values():
             try:
                 proc.wait(5)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        if not args.keep_data:
-            shutil.rmtree(workdir, ignore_errors=True)
-        else:
+
+
+def _submit_cross_shard_tx(net: Net, value: int) -> bytes:
+    """Build + sign a shard-0 -> shard-1 transfer with dev account 0
+    and push it through shard 0's RPC; returns the destination addr."""
+    sys.path.insert(0, str(ROOT))
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.types import Transaction
+
+    _, ecdsa_keys, _ = dev_genesis(n_keys=net.total_keys, shard_id=0)
+    sender_key = ecdsa_keys[0]
+    dest = b"\x2c" * 20
+    port = net.alive_rpc_ports(0)[0]
+    nonce = _rpc(port, "hmyv2_getTransactionCount",
+                 ["0x" + sender_key.address().hex(), "latest"]) or 0
+    tx = Transaction(
+        nonce=int(nonce), gas_price=1, gas_limit=30_000, shard_id=0,
+        to_shard=1, to=dest, value=value,
+    ).sign(sender_key, 2)
+    blob = rawdb.encode_tx(tx, 2)
+    _rpc(port, "hmyv2_sendRawTransaction", ["0x" + blob.hex()])
+    print(f"  cross-shard tx submitted: {value} to 0x{dest.hex()[:12]}.. "
+          f"on shard 1")
+    return dest
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="harmony-tpu localnet")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--multikey", type=int, default=0,
+                   help="first M nodes vote with 2 dev keys each")
+    p.add_argument("--blocks", type=int, default=0,
+                   help="stop after N blocks (0 = run until Ctrl-C)")
+    p.add_argument("--kill-leader-at", type=int, default=0,
+                   help="kill node 0 at this shard-0 height; require a "
+                        "completed view change + continued commits")
+    p.add_argument("--cross-shard", action="store_true",
+                   help="submit a shard-0->1 transfer; require arrival")
+    p.add_argument("--block-time", type=float, default=2.0)
+    p.add_argument("--phase-timeout", type=float, default=27.0,
+                   help="per-node consensus phase timeout; raise on "
+                        "oversubscribed boxes (N nodes share the core)")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--keep-data", action="store_true")
+    args = p.parse_args(argv)
+    if args.cross_shard and args.shards < 2:
+        args.shards = 2
+
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="harmony-tpu-localnet-"))
+    net = Net(args, workdir)
+    t_first_block = None
+    killed_at = None
+    cx_dest = None
+    cx_value = 31337
+    try:
+        net.start()
+        print("waiting for blocks...")
+        last = {s: -1 for s in range(args.shards)}
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            time.sleep(2)
+            net.check_alive()
+            heads = {}
+            for s in range(args.shards):
+                h = net.head(s)
+                heads[s] = h
+                if h is not None and h != last[s]:
+                    print(f"  shard {s} head = {h}")
+                    last[s] = h
+                    if s == 0 and h >= 1 and t_first_block is None:
+                        t_first_block = time.monotonic()
+            h0 = heads.get(0) or 0
+
+            if (args.kill_leader_at and killed_at is None
+                    and h0 >= args.kill_leader_at):
+                net.kill(0, 0)
+                killed_at = h0
+                print(f"  leader-kill scenario armed at head {h0}: chain "
+                      f"must advance {args.nodes} more blocks (a full "
+                      f"rotation past the dead node's slot)")
+
+            if args.cross_shard and cx_dest is None and h0 >= 2 and (
+                    heads.get(1) or 0) >= 1:
+                cx_dest = _submit_cross_shard_tx(net, cx_value)
+
+            # completion: every requested criterion must hold; with no
+            # criteria (pure watch mode) run until Ctrl-C
+            criteria = []
+            if args.blocks:
+                criteria.append(h0 >= args.blocks)
+            if args.kill_leader_at:
+                criteria.append(
+                    killed_at is not None and h0 >= killed_at + args.nodes
+                )
+            if args.cross_shard:
+                arrived = False
+                if cx_dest is not None:
+                    try:
+                        bal = _rpc(net.alive_rpc_ports(1)[0],
+                                   "hmyv2_getBalance",
+                                   ["0x" + cx_dest.hex(), "latest"])
+                    except OSError:
+                        bal = None  # transient RPC stall: retry next tick
+                    arrived = int(bal or 0) >= cx_value
+                    if arrived and not getattr(net, "_cx_done", False):
+                        net._cx_done = True
+                        print(f"  cross-shard transfer ARRIVED on shard 1 "
+                              f"(balance {bal})")
+                criteria.append(arrived)
+
+            if criteria and all(criteria):
+                if killed_at is not None:
+                    vcs = net.grep_logs("adopt new view", shard=0)
+                    if not vcs:
+                        raise RuntimeError(
+                            "chain advanced but no survivor logged a "
+                            "completed view change"
+                        )
+                    print(f"  view change completed ({vcs} 'adopt new "
+                          f"view' log lines among survivors)")
+                rate = None
+                if t_first_block is not None and h0 > 1:
+                    rate = (h0 - 1) / (time.monotonic() - t_first_block)
+                print(
+                    f"localnet OK: shard heads "
+                    f"{ {s: net.head(s) for s in range(args.shards)} }"
+                    + (f", commit rate {rate:.2f} blocks/s" if rate else "")
+                )
+                return 0
+        if not (args.blocks or args.kill_leader_at or args.cross_shard):
+            return 0  # watch mode: the timeout just bounds the run
+        raise RuntimeError(f"scenario incomplete after {args.timeout}s; "
+                           f"logs in {workdir}")
+    except KeyboardInterrupt:
+        return 0
+    except Exception:
+        args.keep_data = True  # failure evidence must survive teardown
+        raise
+    finally:
+        net.stop()
+        if args.keep_data:
             print(f"data kept in {workdir}")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
 
 
 if __name__ == "__main__":
