@@ -286,6 +286,16 @@ def build_node(cfg: dict):
         _CallbackService(metrics.start, metrics.stop),
     )
 
+    if cfg.get("pprof_port") is not None:
+        # reference: api/service/pprof behind cmd/harmony --pprof
+        from .pprof import PprofServer
+
+        pprof = PprofServer(port=int(cfg["pprof_port"]))
+        manager.register(
+            ServiceType.PPROF,
+            _CallbackService(pprof.start, pprof.stop),
+        )
+
     sync_srv = SyncServer(chain, listen_port=cfg["sync_port"])
     manager.register(
         ServiceType.SYNCHRONIZE,
@@ -401,6 +411,9 @@ def main(argv=None):
     p.add_argument("--datadir")
     p.add_argument("--rpc-port", type=int, dest="rpc_port")
     p.add_argument("--metrics-port", type=int, dest="metrics_port")
+    p.add_argument("--pprof-port", type=int, dest="pprof_port",
+                   help="serve /debug/pprof profiles on localhost "
+                        "(off unless given)")
     p.add_argument("--p2p-port", type=int, dest="p2p_port")
     p.add_argument("--sync-port", type=int, dest="sync_port")
     p.add_argument("--peer", action="append", dest="peers")

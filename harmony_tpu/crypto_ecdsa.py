@@ -136,6 +136,24 @@ class ECDSAKey:
         return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([recid])
 
 
+def decompress_pubkey(data: bytes):
+    """SEC1 compressed 33-byte key (02/03 || X) -> the (x, y) point.
+
+    The standard Rosetta/Coinbase wire format (the reference accepts it
+    via go-ethereum's DecompressPubkey in rosetta construction)."""
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise ValueError("want a 33-byte 02/03-prefixed compressed key")
+    x = int.from_bytes(data[1:], "big")
+    if not (0 < x < P):
+        raise ValueError("compressed key x out of range")
+    y = pow(x * x * x + 7, (P + 1) // 4, P)  # sqrt: P % 4 == 3
+    if y * y % P != (x * x * x + 7) % P:
+        raise ValueError("compressed key x not on curve")
+    if (y & 1) != (data[0] & 1):
+        y = P - y
+    return x, y
+
+
 def pub_to_address(pub) -> bytes:
     """keccak256(X || Y)[12:] — the Ethereum-style 20-byte address."""
     x, y = pub

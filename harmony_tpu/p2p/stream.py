@@ -209,31 +209,56 @@ class SyncServer:
 
 
 class SyncClient:
-    """One peer's sync stream (reference: sync/client.go)."""
+    """One peer's sync stream (reference: sync/client.go).
+
+    Connects LAZILY and reconnects on the next call after a failure:
+    peers come up in arbitrary order (a localnet's node 0 boots before
+    its neighbour's server exists) and restart across a node's
+    lifetime; a sync peer being down is a per-call error for the
+    downloader's peer rotation, never a constructor crash."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
                  timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
         self._next_id = 0
         self._lock = threading.Lock()
 
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout
+            )
+
     def _call(self, payload: bytes) -> bytes:
         with self._lock:
-            self._next_id += 1
-            req_id = self._next_id
-            self._sock.sendall(
-                _HDR.pack(len(payload), _REQ, req_id) + payload
-            )
-            while True:
-                hdr = _recv_exact(self._sock, _HDR.size)
-                if hdr is None:
-                    raise ConnectionError("sync stream closed")
-                ln, kind, rid = _HDR.unpack(hdr)
-                body = _recv_exact(self._sock, ln)
-                if body is None:
-                    raise ConnectionError("sync stream closed")
-                if kind == _RESP and rid == req_id:
-                    return body
+            try:
+                self._connect()
+                self._next_id += 1
+                req_id = self._next_id
+                self._sock.sendall(
+                    _HDR.pack(len(payload), _REQ, req_id) + payload
+                )
+                while True:
+                    hdr = _recv_exact(self._sock, _HDR.size)
+                    if hdr is None:
+                        raise ConnectionError("sync stream closed")
+                    ln, kind, rid = _HDR.unpack(hdr)
+                    body = _recv_exact(self._sock, ln)
+                    if body is None:
+                        raise ConnectionError("sync stream closed")
+                    if kind == _RESP and rid == req_id:
+                        return body
+            except (OSError, ConnectionError):
+                # drop the wedged socket; the next call redials
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
 
     def get_head(self) -> tuple[int, bytes]:
         resp = self._call(bytes([METHOD_HEAD]))
@@ -306,10 +331,15 @@ class SyncClient:
         return rawdb.decode_shard_state(resp)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # deliberately lock-free (a _call blocked in recv holds the
+        # lock for up to the timeout): closing the fd makes that recv
+        # raise OSError, whose handler owns the _sock=None cleanup
+        s = self._sock
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def _recv_exact(sock, n: int) -> bytes | None:

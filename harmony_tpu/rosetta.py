@@ -11,9 +11,23 @@ Rosetta integrator reads first, as POST JSON endpoints:
     /block            -> block + transfer operations
     /account/balance  -> balance at the head block
 
+plus the Construction API (reference: rosetta/services/construction.go
++ construction_create.go + construction_submit.go), the offline/online
+split of the signing flow:
+
+    /construction/derive      -> secp256k1 pubkey to address   (offline)
+    /construction/preprocess  -> operations to options         (offline)
+    /construction/metadata    -> nonce + suggested fee         (online)
+    /construction/payloads    -> unsigned tx + signing payload (offline)
+    /construction/parse       -> tx back to operations         (offline)
+    /construction/combine     -> unsigned tx + sig = signed tx (offline)
+    /construction/hash        -> signed tx hash                (offline)
+    /construction/submit      -> broadcast to the pool         (online)
+
 Operation vocabulary mirrors the reference's rosetta operation types
-(NativeTransfer / Gas — rosetta/common/operations.go); construction
-endpoints (signing flows) are out of scope here.
+(NativeTransfer / Gas — rosetta/common/operations.go).  Signatures are
+Rosetta ``ecdsa_recovery`` (65-byte R||S||V), exactly the wire format
+core/types.Transaction carries.
 """
 
 from __future__ import annotations
@@ -48,13 +62,21 @@ class RosettaServer:
                     "/network/options": outer._network_options,
                     "/block": outer._block,
                     "/account/balance": outer._account_balance,
+                    "/construction/derive": outer._cons_derive,
+                    "/construction/preprocess": outer._cons_preprocess,
+                    "/construction/metadata": outer._cons_metadata,
+                    "/construction/payloads": outer._cons_payloads,
+                    "/construction/parse": outer._cons_parse,
+                    "/construction/combine": outer._cons_combine,
+                    "/construction/hash": outer._cons_hash,
+                    "/construction/submit": outer._cons_submit,
                 }.get(self.path)
                 if fn is None:
                     self._reply(404, {"code": 2, "message": "no route"})
                     return
                 try:
                     self._reply(200, fn(req))
-                except (ValueError, KeyError, TypeError) as e:
+                except (ValueError, KeyError, TypeError, IndexError) as e:
                     self._reply(
                         500, {"code": 3, "message": str(e),
                               "retriable": False},
@@ -206,4 +228,187 @@ class RosettaServer:
                 "value": str(self.hmy.get_balance(addr)),
                 "currency": self._currency(),
             }],
+        }
+
+    # -- construction API ---------------------------------------------------
+    # reference: rosetta/services/construction*.go — the offline half
+    # never touches the chain; metadata/submit are the online half.
+
+    @staticmethod
+    def _addr(hexstr: str) -> bytes:
+        return bytes.fromhex(
+            hexstr[2:] if hexstr.startswith("0x") else hexstr
+        )
+
+    def _ops_to_transfer(self, ops: list):
+        """The canonical 2-op NativeTransfer pair -> (frm, to, value)."""
+        frm = to = None
+        value = 0
+        for op in ops:
+            if op.get("type") != "NativeTransfer":
+                continue
+            amt = int(op["amount"]["value"])
+            addr = self._addr(op["account"]["address"])
+            if amt < 0:
+                frm, value = addr, -amt
+            else:
+                to = addr
+        if frm is None or to is None:
+            raise ValueError(
+                "want a debit and a credit NativeTransfer operation"
+            )
+        return frm, to, value
+
+    def _tx_from_blob(self, hexstr: str):
+        from .core import rawdb
+
+        return rawdb.decode_tx(self._addr(hexstr))
+
+    def _tx_blob(self, tx) -> str:
+        from .core import rawdb
+
+        return "0x" + rawdb.encode_tx(tx, self.hmy.chain_id()).hex()
+
+    def _cons_derive(self, req):
+        from .crypto_ecdsa import decompress_pubkey, pub_to_address
+
+        raw = bytes.fromhex(req["public_key"]["hex_bytes"])
+        if len(raw) == 33:  # SEC1 compressed — the standard wire form
+            pub = decompress_pubkey(raw)
+        else:
+            if len(raw) == 65 and raw[0] == 0x04:
+                raw = raw[1:]  # uncompressed SEC1 envelope
+            if len(raw) != 64:
+                raise ValueError(
+                    "want a 33-byte compressed or 64/65-byte "
+                    "uncompressed secp256k1 key"
+                )
+            pub = (int.from_bytes(raw[:32], "big"),
+                   int.from_bytes(raw[32:], "big"))
+        return {
+            "account_identifier": {
+                "address": "0x" + pub_to_address(pub).hex()
+            }
+        }
+
+    def _cons_preprocess(self, req):
+        frm, to, value = self._ops_to_transfer(req["operations"])
+        return {
+            "options": {
+                "from": "0x" + frm.hex(),
+                "to": "0x" + to.hex(),
+                "value": str(value),
+            },
+            "required_public_keys": [
+                {"address": "0x" + frm.hex()}
+            ],
+        }
+
+    def _cons_metadata(self, req):
+        opts = req.get("options") or {}
+        frm = self._addr(opts["from"])
+        gas_limit = 21_000
+        gas_price = max(int(opts.get("gas_price", 0)), 1)
+        return {
+            "metadata": {
+                "nonce": self.hmy.get_nonce(frm),
+                "gas_price": gas_price,
+                "gas_limit": gas_limit,
+            },
+            "suggested_fee": [{
+                "value": str(gas_limit * gas_price),
+                "currency": self._currency(),
+            }],
+        }
+
+    def _build_unsigned(self, ops: list, metadata: dict):
+        from .core.types import Transaction
+
+        frm, to, value = self._ops_to_transfer(ops)
+        shard = self.hmy.shard_id()
+        tx = Transaction(
+            nonce=int(metadata["nonce"]),
+            gas_price=int(metadata["gas_price"]),
+            gas_limit=int(metadata["gas_limit"]),
+            shard_id=shard, to_shard=shard,
+            to=to, value=value,
+        )
+        return frm, tx
+
+    def _cons_payloads(self, req):
+        frm, tx = self._build_unsigned(
+            req["operations"], req["metadata"]
+        )
+        # the UNSIGNED wire form carries the sender address ahead of
+        # the tx blob (the reference wraps its unsigned tx the same
+        # way): a signature-less tx cannot name its sender, and
+        # /construction/parse must round-trip BOTH operations
+        unsigned = "0x" + frm.hex() + self._tx_blob(tx)[2:]
+        return {
+            "unsigned_transaction": unsigned,
+            "payloads": [{
+                "account_identifier": {"address": "0x" + frm.hex()},
+                "hex_bytes": tx.signing_hash(self.hmy.chain_id()).hex(),
+                "signature_type": "ecdsa_recovery",
+            }],
+        }
+
+    def _cons_parse(self, req):
+        raw = self._addr(req["transaction"])
+        if req.get("signed"):
+            from .core import rawdb
+
+            tx = rawdb.decode_tx(raw)
+            sender = tx.sender(self.hmy.chain_id())
+            signers = [{"address": "0x" + sender.hex()}]
+        else:
+            sender, tx = raw[:20], self._tx_from_blob(
+                "0x" + raw[20:].hex()
+            )
+            sender, signers = bytes(sender), []
+        ops = [
+            {
+                "operation_identifier": {"index": 0},
+                "type": "NativeTransfer",
+                "account": {"address": "0x" + sender.hex()},
+                "amount": {"value": str(-tx.value),
+                           "currency": self._currency()},
+            },
+            {
+                "operation_identifier": {"index": 1},
+                "related_operations": [{"index": 0}],
+                "type": "NativeTransfer",
+                "account": {"address": "0x" + (tx.to or b"").hex()},
+                "amount": {"value": str(tx.value),
+                           "currency": self._currency()},
+            },
+        ]
+        return {"operations": ops,
+                "account_identifier_signers": signers}
+
+    def _cons_combine(self, req):
+        raw = self._addr(req["unsigned_transaction"])
+        tx = self._tx_from_blob("0x" + raw[20:].hex())  # drop sender
+        sig = bytes.fromhex(req["signatures"][0]["hex_bytes"])
+        if len(sig) != 65:
+            raise ValueError("ecdsa_recovery signature must be 65 bytes")
+        tx.sig = sig
+        # reject garbage before it can reach /submit: recovery must
+        # yield SOME address (full sender checks happen at the pool)
+        tx.sender(self.hmy.chain_id())
+        return {"signed_transaction": self._tx_blob(tx)}
+
+    def _cons_hash(self, req):
+        tx = self._tx_from_blob(req["signed_transaction"])
+        return {
+            "transaction_identifier": {
+                "hash": "0x" + tx.hash(self.hmy.chain_id()).hex()
+            }
+        }
+
+    def _cons_submit(self, req):
+        blob = self._addr(req["signed_transaction"])
+        tx_hash = self.hmy.send_raw_transaction(blob)
+        return {
+            "transaction_identifier": {"hash": "0x" + tx_hash.hex()}
         }

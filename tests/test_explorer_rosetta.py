@@ -105,3 +105,135 @@ def test_rosetta_data_api(stack):
         assert status == 404
     finally:
         rs.stop()
+
+
+def test_rosetta_construction_end_to_end(stack):
+    """The full Construction flow (reference:
+    rosetta/services/construction*.go): derive -> preprocess ->
+    metadata -> payloads -> [external ECDSA sign] -> combine -> parse
+    -> hash -> submit, landing the tx in the live pool."""
+    chain, keys, to, _ = stack
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    hmy = Harmony(chain, pool)
+    rs = RosettaServer(hmy).start()
+    sender = keys[0]
+    try:
+        # derive: pubkey -> address
+        pub_hex = "04" + sender.pub[0].to_bytes(32, "big").hex() + (
+            sender.pub[1].to_bytes(32, "big").hex()
+        )
+        status, got = _post(rs.port, "/construction/derive",
+                            {"public_key": {"hex_bytes": pub_hex}})
+        assert status == 200
+        assert got["account_identifier"]["address"] == (
+            "0x" + sender.address().hex()
+        )
+        # SEC1 compressed (the standard Rosetta wire form) derives the
+        # same address
+        comp = bytes([2 + (sender.pub[1] & 1)]) + (
+            sender.pub[0].to_bytes(32, "big")
+        )
+        status, got2 = _post(rs.port, "/construction/derive",
+                             {"public_key": {"hex_bytes": comp.hex()}})
+        assert status == 200 and got2 == got
+
+        ops = [
+            {"operation_identifier": {"index": 0},
+             "type": "NativeTransfer",
+             "account": {"address": "0x" + sender.address().hex()},
+             "amount": {"value": "-777",
+                        "currency": {"symbol": "ONE", "decimals": 18}}},
+            {"operation_identifier": {"index": 1},
+             "type": "NativeTransfer",
+             "account": {"address": "0x" + to.hex()},
+             "amount": {"value": "777",
+                        "currency": {"symbol": "ONE", "decimals": 18}}},
+        ]
+        status, pre = _post(rs.port, "/construction/preprocess",
+                            {"operations": ops})
+        assert status == 200
+        assert pre["required_public_keys"][0]["address"] == (
+            "0x" + sender.address().hex()
+        )
+        status, meta = _post(rs.port, "/construction/metadata",
+                             {"options": pre["options"]})
+        assert status == 200
+        assert meta["metadata"]["nonce"] == 1  # one tx already applied
+        status, pay = _post(rs.port, "/construction/payloads",
+                            {"operations": ops,
+                             "metadata": meta["metadata"]})
+        assert status == 200
+        payload = pay["payloads"][0]
+        assert payload["signature_type"] == "ecdsa_recovery"
+
+        # rosetta-cli style intent check: parse(unsigned) must round-
+        # trip BOTH operations, with no signers yet
+        status, up = _post(rs.port, "/construction/parse", {
+            "transaction": pay["unsigned_transaction"], "signed": False,
+        })
+        assert status == 200 and up["account_identifier_signers"] == []
+        assert sorted(
+            int(op["amount"]["value"]) for op in up["operations"]
+        ) == [-777, 777]
+        assert {op["account"]["address"] for op in up["operations"]} == {
+            "0x" + sender.address().hex(), "0x" + to.hex()
+        }
+
+        # degenerate combine input is a Rosetta error, not a hang/reset
+        status, _ = _post(rs.port, "/construction/combine", {
+            "unsigned_transaction": pay["unsigned_transaction"],
+            "signatures": [],
+        })
+        assert status == 500
+
+        # the signer is EXTERNAL to the server: sign the payload bytes
+        sig = sender.sign(bytes.fromhex(payload["hex_bytes"]))
+        status, comb = _post(rs.port, "/construction/combine", {
+            "unsigned_transaction": pay["unsigned_transaction"],
+            "signatures": [{"hex_bytes": sig.hex()}],
+        })
+        assert status == 200
+
+        status, parsed = _post(rs.port, "/construction/parse", {
+            "transaction": comb["signed_transaction"], "signed": True,
+        })
+        assert status == 200
+        assert parsed["account_identifier_signers"] == [
+            {"address": "0x" + sender.address().hex()}
+        ]
+        amounts = sorted(
+            int(op["amount"]["value"]) for op in parsed["operations"]
+        )
+        assert amounts == [-777, 777]
+
+        status, hsh = _post(rs.port, "/construction/hash", {
+            "signed_transaction": comb["signed_transaction"],
+        })
+        assert status == 200
+
+        status, sub = _post(rs.port, "/construction/submit", {
+            "signed_transaction": comb["signed_transaction"],
+        })
+        assert status == 200
+        assert sub["transaction_identifier"] == (
+            hsh["transaction_identifier"]
+        )
+        assert len(pool) == 1  # landed in the live mempool
+
+        # a corrupted signature recovers to a DIFFERENT address (that's
+        # the nature of ecdsa_recovery) — the pool's sender checks must
+        # then reject the submit
+        bad = bytearray(sig)
+        bad[40] ^= 0x01
+        status, comb2 = _post(rs.port, "/construction/combine", {
+            "unsigned_transaction": pay["unsigned_transaction"],
+            "signatures": [{"hex_bytes": bytes(bad).hex()}],
+        })
+        if status == 200:  # recovery happened to succeed
+            status, _ = _post(rs.port, "/construction/submit", {
+                "signed_transaction": comb2["signed_transaction"],
+            })
+        assert status == 500
+        assert len(pool) == 1  # nothing new landed
+    finally:
+        rs.stop()

@@ -275,3 +275,54 @@ def test_rpc_staking_reads(stack):
     assert _call(
         srv.port, "hmy_getElectedValidatorAddresses"
     )["result"] == []
+
+
+def test_pprof_service_profiles():
+    """reference: api/service/pprof — live profiling endpoint
+    (goroutine==thread dump, sampling CPU profile, heap, threadz)."""
+    import http.client
+    import threading
+    import time
+
+    from harmony_tpu.pprof import PprofServer
+
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=busy, name="busy-loop", daemon=True)
+    t.start()
+    srv = PprofServer().start()
+    try:
+        def get(path):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            out = (resp.status, resp.read().decode())
+            conn.close()
+            return out
+
+        status, idx = get("/debug/pprof/")
+        assert status == 200 and "goroutine" in idx
+        status, dump = get("/debug/pprof/goroutine")
+        assert status == 200 and "busy" in dump
+        status, prof = get("/debug/pprof/profile?seconds=0.5")
+        assert status == 200
+        assert "busy@" in prof  # the hot loop dominates the samples
+        status, tz = get("/debug/pprof/threadz")
+        assert status == 200 and "busy-loop" in tz
+        status, heap1 = get("/debug/pprof/heap")
+        assert status == 200  # first call arms tracemalloc
+        blobs = [bytearray(3000) for _ in range(50)]
+        status, heap2 = get("/debug/pprof/heap")
+        assert status == 200 and "size=" in heap2
+        del blobs
+        import tracemalloc
+
+        tracemalloc.stop()
+    finally:
+        stop.set()
+        srv.stop()
