@@ -152,6 +152,83 @@ def open_chain_for_maintenance(cfg: dict) -> Blockchain:
     )
 
 
+def load_node_bls_keys(cfg: dict, dev_bls=None):
+    """Resolve the node's BLS signing keys from config (reference:
+    internal/blsgen — passphrase file/env/console prompt sources,
+    KMS envelopes, --bls.dir multikey directories) or the dev
+    genesis keys."""
+    # BLS keys: encrypted keyfiles (passphrase from file/env/console),
+    # KMS envelopes, a multibls key directory — or dev keys on the dev
+    # genesis (reference: internal/blsgen config.go passphrase sources
+    # + kms.go + the --bls.dir multikey mode)
+    entries = list(cfg["bls_keys"] or [])
+    if cfg.get("bls_dir"):
+        import glob as _glob
+
+        for path in sorted(
+            _glob.glob(os.path.join(cfg["bls_dir"], "*.key"))
+        ):
+            entries.append({
+                "path": path,
+                "passphrase_file": cfg.get("bls_dir_passphrase_file"),
+                "passphrase_env": cfg.get("bls_dir_passphrase_env"),
+            })
+    if entries:
+        loaded = []
+        kms_provider = None
+        for entry in entries:
+            if entry.get("kms"):
+                if kms_provider is None:
+                    from .blsgen_kms import LocalKMSProvider
+
+                    master = cfg.get("kms_master_key")
+                    if not master:
+                        raise ValueError(
+                            "kms_master_key required for kms bls keys"
+                        )
+                    kms_provider = LocalKMSProvider(master)
+                from . import bls as _bls
+                from .blsgen_kms import load_kms_key
+
+                loaded.append(_bls.PrivateKey.from_bytes(
+                    load_kms_key(entry["path"], kms_provider)
+                ))
+                continue
+            if entry.get("passphrase_file"):
+                with open(entry["passphrase_file"]) as f:
+                    passphrase = f.read().strip()
+            elif entry.get("passphrase_env"):
+                passphrase = os.environ.get(entry["passphrase_env"])
+                if passphrase is None:
+                    raise ValueError(
+                        f"passphrase env {entry['passphrase_env']!r} "
+                        f"unset for {entry['path']}"
+                    )
+            else:
+                # operator console (reference: blsgen prompts when no
+                # pass source is configured; non-interactive runs must
+                # configure one instead)
+                if not sys.stdin.isatty():
+                    raise ValueError(
+                        f"no passphrase source for {entry['path']} and "
+                        "stdin is not a terminal"
+                    )
+                import getpass
+
+                passphrase = getpass.getpass(
+                    f"Enter the BLS key passphrase for {entry['path']}: "
+                )
+            loaded.extend(load_keys([(entry["path"], passphrase)]))
+        keys = PrivateKeys.from_keys(loaded)
+    elif dev_bls is not None:
+        keys = PrivateKeys.from_keys(dev_bls)
+    else:
+        raise ValueError(
+            "bls_keys required when a custom genesis is supplied"
+        )
+    return keys
+
+
 def build_node(cfg: dict):
     """Wire every subsystem; returns (node, services, registry)."""
     os.makedirs(cfg["datadir"], exist_ok=True)
@@ -227,19 +304,7 @@ def build_node(cfg: dict):
         reg_epoch_chain = None
     pool = TxPool(genesis.config.chain_id, cfg["shard_id"], chain.state)
 
-    # BLS keys: encrypted keyfiles, or dev keys on the dev genesis
-    if cfg["bls_keys"]:
-        pairs = []
-        for entry in cfg["bls_keys"]:
-            with open(entry["passphrase_file"]) as f:
-                pairs.append((entry["path"], f.read().strip()))
-        keys = PrivateKeys.from_keys(load_keys(pairs))
-    elif dev_bls is not None:
-        keys = PrivateKeys.from_keys(dev_bls)
-    else:
-        raise ValueError(
-            "bls_keys required when a custom genesis is supplied"
-        )
+    keys = load_node_bls_keys(cfg, dev_bls)
 
     host = TCPHost(name=f"shard{cfg['shard_id']}-{os.getpid()}",
                    listen_port=cfg["p2p_port"])

@@ -281,8 +281,16 @@ class StateDB:
         staking state the reference keeps in ValidatorWrapper storage).
         Execution stays flat; this root exists for reference-shaped
         interop and inclusion proofs."""
+        from .trie import trie_root
+
+        return trie_root(self._mpt_account_items())
+
+    def _mpt_account_items(self) -> dict:
+        """keccak(address) -> RLP account leaf: the exact key/value
+        set mpt_root commits and account_proof proves against."""
+        from ..ref.keccak import keccak256
         from .. import rlp
-        from .trie import EMPTY_ROOT, secure_trie_root, trie_root
+        from .trie import EMPTY_ROOT, secure_trie_root
 
         items = {}
         for addr, acct in self._live_accounts():
@@ -293,15 +301,44 @@ class StateDB:
                 })
             else:
                 storage_root = EMPTY_ROOT
-            code_hash = keccak256(acct.code)
-            val_hash = keccak256(
-                acct.validator.encode() if acct.validator else b""
-            )
-            items[addr] = rlp.encode([
-                acct.nonce, acct.balance, storage_root, code_hash,
-                val_hash,
+            items[keccak256(addr)] = rlp.encode([
+                acct.nonce, acct.balance, storage_root,
+                keccak256(acct.code),
+                keccak256(
+                    acct.validator.encode() if acct.validator else b""
+                ),
             ])
-        return secure_trie_root(items)
+        return items
+
+    def account_proof(self, addr: bytes, slots: list | None = None):
+        """eth_getProof-shaped Merkle proofs against mpt_root():
+        (mpt_root, account_leaf_rlp_or_b'', account_proof_nodes,
+        [(slot, value, proof_nodes)...]).  Each trie is built once and
+        walked per key.  reference: the go-ethereum GetProof RPC over
+        core/state."""
+        from ..ref.keccak import keccak256
+        from .. import rlp
+        from .trie import build_proof_db, prove_from
+
+        items = self._mpt_account_items()
+        key = keccak256(addr)
+        root, nodes = build_proof_db(items)
+        acct_proof = prove_from(root, nodes, key)
+        leaf = items.get(key, b"")
+        storage_proofs = []
+        acct = self._accounts.get(addr)
+        if slots:
+            storage_items = {
+                keccak256(k): rlp.encode(rlp.int_to_bytes(v))
+                for k, v in (acct.storage if acct else {}).items() if v
+            }
+            sroot, snodes = build_proof_db(storage_items)
+            for slot in slots:
+                val = acct.storage.get(slot, 0) if acct else 0
+                storage_proofs.append(
+                    (slot, val, prove_from(sroot, snodes, keccak256(slot)))
+                )
+        return root, leaf, acct_proof, storage_proofs
 
     # -- persistence -------------------------------------------------------
 

@@ -131,6 +131,110 @@ class Trie:
         return children + [value]
 
 
+def _hp_decode(data: bytes):
+    """Inverse of _hp_encode -> (nibbles, is_leaf)."""
+    flag = data[0] >> 4
+    nibs = []
+    if flag & 1:
+        nibs.append(data[0] & 0x0F)
+    for b in data[1:]:
+        nibs.append(b >> 4)
+        nibs.append(b & 0x0F)
+    return nibs, bool(flag & 2)
+
+
+def build_proof_db(items: dict):
+    """(root, {hash: encoded node}) for a key/value set — build the
+    trie ONCE, then prove_from() walks it per key (an eth_getProof
+    request proves several keys against the same trie)."""
+    nodes: dict[bytes, bytes] = {}
+    t = Trie(store=lambda h, enc: nodes.__setitem__(h, enc))
+    for k, v in items.items():
+        t.update(k, v)
+    return t.root(), nodes
+
+
+def prove(items: dict, key: bytes) -> list:
+    """One-shot convenience over build_proof_db + prove_from."""
+    root, nodes = build_proof_db(items)
+    return prove_from(root, nodes, key)
+
+
+def prove_from(root: bytes, nodes: dict, key: bytes) -> list:
+    """Merkle inclusion/exclusion proof: the RLP encodings of every
+    HASHED node on ``key``'s path, root first (go-ethereum
+    Trie.Prove's format — what eth_getProof carries).  Inline (<32 B)
+    nodes ride inside their parents, per the yellow-paper reference
+    rule, so the list is exactly the resolvable path."""
+    if root == EMPTY_ROOT:
+        return []
+    proof = [nodes[root]]
+    node = rlp.decode(nodes[root])
+    nibs = _to_nibbles(key)
+    while True:
+        if len(node) == 2:
+            prefix, is_leaf = _hp_decode(node[0])
+            if is_leaf or prefix != nibs[:len(prefix)]:
+                return proof  # arrived (or proved absent)
+            nibs = nibs[len(prefix):]
+            ref = node[1]
+        elif len(node) == 17:
+            if not nibs:
+                return proof  # value sits in this branch
+            ref, nibs = node[nibs[0]], nibs[1:]
+        else:
+            raise ValueError("malformed trie node")
+        if isinstance(ref, list):
+            node = ref  # inline child: part of the parent's encoding
+        elif len(ref) == 32 and ref in nodes:
+            proof.append(nodes[ref])
+            node = rlp.decode(nodes[ref])
+        else:
+            return proof  # absent key diverged
+
+
+def verify_proof(root: bytes, key: bytes, proof: list):
+    """Walk a Trie.prove-style proof; returns the value at ``key`` (b""
+    for a proven absence) or raises ValueError on a broken proof."""
+    if not proof:
+        if root == EMPTY_ROOT:
+            return b""
+        raise ValueError("empty proof for non-empty root")
+    by_hash = {keccak256(enc): enc for enc in proof}
+    if root not in by_hash:
+        raise ValueError("proof does not start at the root")
+    node = rlp.decode(by_hash[root])
+    nibs = _to_nibbles(key)
+    while True:
+        if len(node) == 2:
+            prefix, is_leaf = _hp_decode(node[0])
+            if prefix != nibs[:len(prefix)]:
+                return b""  # path diverges: proven absent
+            nibs = nibs[len(prefix):]
+            if is_leaf:
+                if nibs:
+                    return b""
+                return node[1]
+            ref = node[1]
+        elif len(node) == 17:
+            if not nibs:
+                return node[16]
+            ref, nibs = node[nibs[0]], nibs[1:]
+        else:
+            raise ValueError("malformed trie node")
+        if isinstance(ref, list):
+            node = ref
+        elif ref == b"":
+            return b""  # no child on the path: proven absent
+        elif len(ref) == 32:
+            enc = by_hash.get(ref)
+            if enc is None:
+                raise ValueError("proof is missing a path node")
+            node = rlp.decode(enc)
+        else:
+            raise ValueError("malformed node reference")
+
+
 def trie_root(items: dict) -> bytes:
     """Root of a key->value map (empty values are absent keys)."""
     t = Trie()

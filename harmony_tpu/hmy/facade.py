@@ -45,6 +45,16 @@ class Harmony:
     def get_nonce(self, address: bytes) -> int:
         return self.chain.state().nonce(address)
 
+    def get_proof(self, address: bytes, slots: list,
+                  block_num: int | None = None):
+        """eth_getProof backing: (mpt_root, account leaf, account
+        proof nodes, storage proofs) at a block's state."""
+        if block_num is None or block_num >= self.chain.head_number:
+            state = self.chain.state()
+        else:
+            state = self.chain.state_at(block_num)
+        return state.account_proof(address, slots)
+
     def chain_id(self) -> int:
         return self.chain.config.chain_id
 

@@ -482,6 +482,56 @@ class RPCServer:
     def _gasPrice(self, params, v2):
         return self._int(1_000_000_000, v2)  # min gas price placeholder
 
+    def _getProof(self, params, v2):
+        """eth_getProof (reference: the go-ethereum GetProof RPC the
+        fork carries): Merkle account + storage proofs against the
+        MPT state commitment (StateDB.mpt_root) — verifiable with
+        core/trie.verify_proof.  Note the account leaf is this chain's
+        5-field RLP (nonce, balance, storageRoot, codeHash,
+        validatorHash); the extra field carries staking state."""
+        from .. import rlp as _rlp
+
+        addr = _addr(params[0])
+        slots = [
+            (int(s, 16) if isinstance(s, str) else int(s)).to_bytes(
+                32, "big"
+            )
+            for s in (params[1] or [])
+        ]
+        num = None
+        if len(params) > 2 and params[2] is not None:
+            num = _block_num(params[2], self.hmy.block_number())
+        root, leaf, acct_proof, storage = self.hmy.get_proof(
+            addr, slots, num
+        )
+        from ..core.trie import EMPTY_ROOT
+        from ..ref.keccak import keccak256 as _keccak
+
+        nonce, balance = 0, 0
+        storage_root, code_hash = EMPTY_ROOT, _keccak(b"")
+        if leaf:
+            fields = _rlp.decode(leaf)
+            nonce = _rlp.decode_int(fields[0])
+            balance = _rlp.decode_int(fields[1])
+            storage_root, code_hash = fields[2], fields[3]
+        return {
+            "address": "0x" + addr.hex(),
+            "stateRoot": "0x" + root.hex(),
+            "balance": self._int(balance, v2),
+            "nonce": self._int(nonce, v2),
+            "codeHash": "0x" + code_hash.hex(),
+            "storageHash": "0x" + storage_root.hex(),
+            "accountProof": ["0x" + n.hex() for n in acct_proof],
+            "storageProof": [
+                {
+                    "key": "0x" + slot.hex(),
+                    "value": self._int(val, v2),
+                    "proof": ["0x" + n.hex() for n in nodes],
+                }
+                for slot, val, nodes in storage
+            ],
+        }
+
     # -- debug namespace (reference: eth/tracers callTracer) ---------------
 
     def _traceTransaction(self, params, v2):

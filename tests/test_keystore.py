@@ -57,3 +57,62 @@ def test_load_keys_multi(tmp_path):
         pairs.append((str(p), f"pw{i}"))
     loaded = KS.load_keys(pairs)
     assert [k.pub for k in loaded] == [k.pub for k in sks]
+
+
+def test_load_node_bls_keys_sources(tmp_path, monkeypatch):
+    """The blsgen operational surface (reference: internal/blsgen
+    config.go): passphrase from file, from env, a multikey directory,
+    and KMS envelopes — all through one resolver."""
+    from harmony_tpu import bls as B
+    from harmony_tpu.blsgen_kms import LocalKMSProvider, save_kms_key
+    from harmony_tpu.cli import load_node_bls_keys
+    from harmony_tpu.keystore import save_key
+
+    k1 = B.PrivateKey.generate(b"blsgen-one")
+    k2 = B.PrivateKey.generate(b"blsgen-two")
+    k3 = B.PrivateKey.generate(b"blsgen-three")
+    k4 = B.PrivateKey.generate(b"blsgen-four")
+
+    # passphrase file
+    save_key(str(tmp_path / "a.key"), k1, "pw-one")
+    (tmp_path / "a.pass").write_text("pw-one\n")
+    # passphrase env
+    save_key(str(tmp_path / "b.key"), k2, "pw-two")
+    monkeypatch.setenv("B_PASS", "pw-two")
+    # a multikey directory sharing one passphrase file
+    d = tmp_path / "multikey"
+    d.mkdir()
+    save_key(str(d / "c.key"), k3, "pw-dir")
+    (tmp_path / "dir.pass").write_text("pw-dir")
+    # KMS envelope
+    LocalKMSProvider.generate_master(str(tmp_path / "master"))
+    provider = LocalKMSProvider(str(tmp_path / "master"))
+    save_kms_key(str(tmp_path / "d.kms"), k4.bytes, provider)
+
+    cfg = {
+        "bls_keys": [
+            {"path": str(tmp_path / "a.key"),
+             "passphrase_file": str(tmp_path / "a.pass")},
+            {"path": str(tmp_path / "b.key"), "passphrase_env": "B_PASS"},
+            {"path": str(tmp_path / "d.kms"), "kms": True},
+        ],
+        "bls_dir": str(d),
+        "bls_dir_passphrase_file": str(tmp_path / "dir.pass"),
+        "kms_master_key": str(tmp_path / "master"),
+    }
+    keys = load_node_bls_keys(cfg)
+    got = {k.pub.bytes for k in keys}
+    assert got == {k1.pub.bytes, k2.pub.bytes, k3.pub.bytes, k4.pub.bytes}
+
+    # unset env is a config error, not a hang
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        load_node_bls_keys({"bls_keys": [
+            {"path": str(tmp_path / "b.key"), "passphrase_env": "NOPE"},
+        ]})
+    # no source + no tty: refuse rather than prompt into the void
+    with _pytest.raises(ValueError):
+        load_node_bls_keys({"bls_keys": [
+            {"path": str(tmp_path / "b.key")},
+        ]})

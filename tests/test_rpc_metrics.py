@@ -326,3 +326,34 @@ def test_pprof_service_profiles():
     finally:
         stop.set()
         srv.stop()
+
+
+def test_eth_get_proof(stack):
+    """eth_getProof: the returned account + storage proofs verify
+    against the returned state root with core/trie.verify_proof."""
+    from harmony_tpu import rlp
+    from harmony_tpu.core.trie import verify_proof
+    from harmony_tpu.ref.keccak import keccak256
+
+    srv, hmy, keys, to, _ = stack
+    addr_hex = "0x" + to.hex()
+    resp = _call(srv.port, "eth_getProof", [addr_hex, [], "latest"])
+    got = resp["result"]
+    # the module fixture accumulates transfers to `to` across tests:
+    # pin to the LIVE balance, and require the proof leaf to match it
+    live = hmy.get_balance(to)
+    assert live >= 5555 and int(got["balance"], 16) == live
+    root = bytes.fromhex(got["stateRoot"][2:])
+    proof = [bytes.fromhex(n[2:]) for n in got["accountProof"]]
+    leaf = verify_proof(root, keccak256(to), proof)
+    fields = rlp.decode(leaf)
+    assert rlp.decode_int(fields[1]) == live
+    # absent account: exclusion proof against the same root
+    resp = _call(srv.port, "eth_getProof", ["0x" + "ef" * 20, []])
+    got = resp["result"]
+    assert int(got["balance"], 16) == 0
+    proof = [bytes.fromhex(n[2:]) for n in got["accountProof"]]
+    assert verify_proof(
+        bytes.fromhex(got["stateRoot"][2:]),
+        keccak256(b"\xef" * 20), proof,
+    ) == b""
