@@ -48,7 +48,7 @@ from ..core.blockchain import ChainError
 from ..log import get_logger
 from ..multibls import PrivateKeys
 from ..p2p import consensus_topic
-from ..p2p.host import ACCEPT, IGNORE
+from ..p2p.host import ACCEPT, IGNORE, REJECT
 from .ingress import (
     VIEW_ID_WINDOW,
     IngressContext,
@@ -227,7 +227,9 @@ class Node:
                 return ACCEPT  # not ours to judge
             msg = decode_message(body)
         except ValueError:
-            return IGNORE
+            # unparseable consensus bytes are junk, not filtering —
+            # REJECT is the punishable verdict (host peer scoring)
+            return REJECT
         ctx = IngressContext(
             shard_id=self.chain.shard_id,
             current_view_id=self.view_id,

@@ -20,6 +20,7 @@ Message size cap mirrors the reference's 2 MB (p2p/host.go:98-99).
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import threading
@@ -68,6 +69,12 @@ class _SeenCache:
             while len(self._d) > self.cap:
                 self._d.popitem(last=False)
             return False
+
+    def forget(self, mid: bytes):
+        """Un-mark a message (shed at an overflow, not processed):
+        a later re-flood by another peer must still be ingestible."""
+        with self._lock:
+            self._d.pop(mid, None)
 
 
 class Host:
@@ -169,9 +176,22 @@ class TCPHost(Host):
     """Flood gossip over TCP.
 
     Peers are symmetric: either side connects (``connect``), both ends
-    then exchange HELLO (name) and flood PUBLISH frames.  Delivery and
-    re-flood run on a per-peer reader thread.
+    then exchange HELLO (name) and flood PUBLISH frames.  Validation,
+    delivery, and re-flood run on a BOUNDED worker pool, decoupled from
+    the per-peer reader threads (reference: p2p/host.go:92-99 — the
+    8192-slot validate pool; readers must keep draining sockets while a
+    validator does pairing work, and a message flood must translate
+    into dropped messages + a counter, not unbounded thread growth).
+
+    Peer scoring (the role of gossipsub's score function): every
+    validator IGNORE decrements the sender's score; below the floor
+    the peer is dropped and its IP banned through the gater.
     """
+
+    VALIDATE_QUEUE_CAP = 8192  # reference: p2p/host.go maxSize
+    VALIDATE_WORKERS = 4
+    SCORE_FLOOR = -20.0
+    SCORE_DECAY_PER_S = 0.5  # forgiveness rate for honest mistakes
 
     def __init__(self, name: str = "", listen_port: int = 0,
                  gater: Gater | None = None):
@@ -184,6 +204,17 @@ class TCPHost(Host):
         # (its own + those ADVERTed by / learned from peers)
         self.known_addrs: dict[str, float] = {}  # "ip:port" -> learned-at
         self._peer_addr: dict[object, str] = {}  # socket -> advertised
+        # bounded validation pool + scoring
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._val_queue: queue.Queue = queue.Queue(self.VALIDATE_QUEUE_CAP)
+        self.dropped_overflow = 0  # messages shed at the full queue
+        self._score_lock = threading.Lock()
+        self._scores: dict[int, tuple[float, float]] = {}  # sockid->(s,at)
+        for i in range(self.VALIDATE_WORKERS):
+            threading.Thread(
+                target=self._validate_worker, daemon=True,
+                name=f"p2p-validate-{name}-{i}",
+            ).start()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", listen_port))
@@ -193,9 +224,13 @@ class TCPHost(Host):
 
     # -- wire ---------------------------------------------------------------
 
-    @staticmethod
-    def _send_frame(sock, kind: int, payload: bytes):
-        sock.sendall(_FRAME.pack(len(payload), kind) + payload)
+    def _send_frame(self, sock, kind: int, payload: bytes):
+        # one frame at a time per socket: floods now run on several
+        # validate workers, and interleaved sendall would corrupt the
+        # length-prefixed framing
+        lock = self._send_locks.setdefault(id(sock), threading.Lock())
+        with lock:
+            sock.sendall(_FRAME.pack(len(payload), kind) + payload)
 
     @staticmethod
     def _recv_exact(sock, n: int) -> bytes | None:
@@ -259,7 +294,7 @@ class TCPHost(Host):
                 if body is None:
                     return
                 if kind == _KIND_PUBLISH:
-                    self._on_publish(body, sock, peer_name)
+                    self._on_publish(body, sock, peer_name, ip)
                 elif kind == _KIND_ADVERT and ln <= 64:
                     addr = body.decode(errors="replace")
                     with self._peer_lock:
@@ -284,6 +319,16 @@ class TCPHost(Host):
             with self._peer_lock:
                 dropped = self._peers.pop(sock, None)
                 self._peer_addr.pop(sock, None)
+                live = {id(s) for s in self._peers}
+            self._send_locks.pop(id(sock), None)
+            with self._score_lock:
+                self._scores.pop(id(sock), None)
+            # an in-flight flood can setdefault a lock back after the
+            # pop above; prune stale ids when churn accumulates them
+            if len(self._send_locks) > 2 * len(live) + 16:
+                for sid in list(self._send_locks):
+                    if sid not in live:
+                        self._send_locks.pop(sid, None)
             if dropped is not None and not self._closing:
                 _log.info("peer disconnected", me=self.name, peer=dropped)
             self.gater.release(ip)
@@ -299,19 +344,80 @@ class TCPHost(Host):
         t = topic.encode()
         return bytes([len(t)]) + t + payload
 
-    def _on_publish(self, body: bytes, src_sock, frm: str):
-        tlen = body[0]
-        topic = body[1:1 + tlen].decode()
-        payload = body[1 + tlen:]
+    def _on_publish(self, body: bytes, src_sock, frm: str, ip: str):
         mid = keccak256(body)
         if self._seen.seen(mid):
             return
-        verdict = self._validate(topic, payload, frm)
-        if verdict != ACCEPT:
-            return
-        if topic in self._handlers:
-            self._deliver(topic, payload, frm)
-        self._flood(body, exclude=src_sock)
+        try:
+            self._val_queue.put_nowait((body, src_sock, frm, ip, mid))
+        except queue.Full:
+            # DoS economy: shed load here, count it, keep reading —
+            # and un-mark the id so another peer's re-flood of the
+            # same message stays ingestible after the burst
+            self._seen.forget(mid)
+            with self._score_lock:
+                self.dropped_overflow += 1
+
+    def _validate_worker(self):
+        while not self._closing:
+            try:
+                body, src_sock, frm, ip, _ = self._val_queue.get(
+                    timeout=0.5
+                )
+            except queue.Empty:
+                continue
+            try:
+                tlen = body[0]
+                topic = body[1:1 + tlen].decode()
+                payload = body[1 + tlen:]
+                verdict = self._validate(topic, payload, frm)
+            except Exception:  # noqa: BLE001 — malformed frame
+                verdict = REJECT
+            if verdict == REJECT:
+                # gossipsub semantics: only REJECT (malformed/bogus
+                # bytes) is punishable; IGNORE is routine filtering
+                # (role-bound types, stale views) and must cost the
+                # sender nothing
+                self._punish(ip, src_sock)
+                continue
+            if verdict != ACCEPT:
+                continue
+            try:
+                if topic in self._handlers:
+                    self._deliver(topic, payload, frm)
+                self._flood(body, exclude=src_sock)
+            except Exception:  # noqa: BLE001 — a raising subscriber
+                # must not kill the pool (4 such and the host goes
+                # permanently deaf); surface it and move on
+                _log.error(
+                    "gossip handler raised", me=self.name, topic=topic,
+                )
+
+    def _punish(self, ip: str, sock):
+        """Score the CONNECTION down for a rejected message; at the
+        floor, drop it and ban the IP through the gater (gossipsub
+        scoring's role, on the flood topology).  Scores key on the
+        connection so peers sharing an address don't pool penalties;
+        the ban itself is per-IP — that's the gater's model."""
+        now = time.monotonic()
+        with self._score_lock:
+            score, at = self._scores.get(id(sock), (0.0, now))
+            score = min(
+                0.0, score + (now - at) * self.SCORE_DECAY_PER_S
+            ) - 1.0
+            self._scores[id(sock)] = (score, now)
+        if score <= self.SCORE_FLOOR:
+            _log.warn(
+                "peer banned for spam", me=self.name, ip=ip,
+                score=round(score, 1),
+            )
+            self.gater.ban(ip)
+            with self._score_lock:
+                self._scores.pop(id(sock), None)
+            try:
+                sock.close()  # reader thread unwinds and releases
+            except OSError:
+                pass
 
     def _flood(self, body: bytes, exclude=None):
         with self._peer_lock:

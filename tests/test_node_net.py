@@ -600,3 +600,51 @@ def test_operator_distinct_leader_rotation():
     # without the gate: uniform over all 4 slots
     genesis.config.leader_rotation_epoch = None
     assert [node.leader_key(v) for v in range(4)] == serialized
+
+
+def test_tcp_validation_pool_and_peer_scoring():
+    """reference: p2p/host.go's bounded validate pool + gossipsub
+    scoring's role: spam that fails validation drives the sender's
+    score to the floor, banning its IP through the gater; the reader
+    thread never blocks on a slow validator."""
+    h1 = TCPHost("spammer")
+    h2 = TCPHost("victim")
+    h2.SCORE_FLOOR = -5.0  # fail fast for the test
+    try:
+        h1.connect(h2.port)
+        assert h2.wait_for_peers(1) and h1.wait_for_peers(1)
+        from harmony_tpu.p2p.host import REJECT
+
+        good = []
+
+        def verdict(p, f):
+            if p.startswith(b"ok"):
+                return ACCEPT
+            if p.startswith(b"meh"):
+                return IGNORE  # routine filtering: NOT punishable
+            return REJECT
+
+        h2.add_validator("t", verdict)
+        h2.subscribe("t", lambda t, p, f: good.append(p))
+        h1.publish("t", b"ok-1")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not good:
+            time.sleep(0.01)
+        assert good == [b"ok-1"]
+        # IGNOREd traffic accrues no score: the peer must survive it
+        # (gossipsub semantics — role filtering is free)
+        for i in range(10):
+            h1.publish("t", b"meh-%d" % i)
+        time.sleep(1.0)
+        assert h2.peer_count() == 1
+        # REJECTed junk: the victim bans the spammer
+        for i in range(10):
+            h1.publish("t", b"junk-%d" % i)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and h2.peer_count():
+            time.sleep(0.05)
+        assert h2.peer_count() == 0  # dropped
+        assert not h2.gater.allow("127.0.0.1")  # and banned
+        assert good == [b"ok-1"]  # junk never delivered
+    finally:
+        h1.close(), h2.close()
