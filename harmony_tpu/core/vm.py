@@ -463,6 +463,127 @@ class CallTracer:
             node["error"] = "execution reverted"
 
 
+OPCODE_NAMES = {
+    0x00: "STOP", 0x01: "ADD", 0x02: "MUL", 0x03: "SUB", 0x04: "DIV",
+    0x05: "SDIV", 0x06: "MOD", 0x07: "SMOD", 0x08: "ADDMOD",
+    0x09: "MULMOD", 0x0A: "EXP", 0x0B: "SIGNEXTEND", 0x10: "LT",
+    0x11: "GT", 0x12: "SLT", 0x13: "SGT", 0x14: "EQ", 0x15: "ISZERO",
+    0x16: "AND", 0x17: "OR", 0x18: "XOR", 0x19: "NOT", 0x1A: "BYTE",
+    0x1B: "SHL", 0x1C: "SHR", 0x1D: "SAR", 0x20: "SHA3",
+    0x30: "ADDRESS", 0x31: "BALANCE", 0x32: "ORIGIN", 0x33: "CALLER",
+    0x34: "CALLVALUE", 0x35: "CALLDATALOAD", 0x36: "CALLDATASIZE",
+    0x37: "CALLDATACOPY", 0x38: "CODESIZE", 0x39: "CODECOPY",
+    0x3A: "GASPRICE", 0x3B: "EXTCODESIZE", 0x3C: "EXTCODECOPY",
+    0x3D: "RETURNDATASIZE", 0x3E: "RETURNDATACOPY", 0x3F: "EXTCODEHASH",
+    0x40: "BLOCKHASH", 0x41: "COINBASE", 0x42: "TIMESTAMP",
+    0x43: "NUMBER", 0x44: "DIFFICULTY", 0x45: "GASLIMIT",
+    0x46: "CHAINID", 0x47: "SELFBALANCE", 0x50: "POP", 0x51: "MLOAD",
+    0x52: "MSTORE", 0x53: "MSTORE8", 0x54: "SLOAD", 0x55: "SSTORE",
+    0x56: "JUMP", 0x57: "JUMPI", 0x58: "PC", 0x59: "MSIZE", 0x5A: "GAS",
+    0x5B: "JUMPDEST", 0xF0: "CREATE", 0xF1: "CALL", 0xF2: "CALLCODE",
+    0xF3: "RETURN", 0xF4: "DELEGATECALL", 0xF5: "CREATE2",
+    0xFA: "STATICCALL", 0xFD: "REVERT", 0xFE: "INVALID",
+    0xFF: "SELFDESTRUCT",
+    **{0x5F + n: f"PUSH{n}" for n in range(33)},
+    **{0x80 + n: f"DUP{n + 1}" for n in range(16)},
+    **{0x90 + n: f"SWAP{n + 1}" for n in range(16)},
+    **{0xA0 + n: f"LOG{n}" for n in range(5)},
+}
+
+
+class StructLogTracer(CallTracer):
+    """The default geth tracer's structLogs (reference: eth/tracers —
+    debug_traceTransaction with no tracer option returns opcode-level
+    struct logs).  Collects {pc, op, gas, depth, stack} per step, list
+    capped so a gas-heavy loop can't OOM the RPC server."""
+
+    def __init__(self, max_steps: int = 50_000, with_stack: bool = True):
+        super().__init__()
+        self.logs: list[dict] = []
+        self.max_steps = max_steps
+        self.with_stack = with_stack
+        self.truncated = False
+
+    def step(self, pc, op, gas, depth, stack, mem_size):
+        if len(self.logs) >= self.max_steps:
+            self.truncated = True  # surfaced by the RPC layer: a
+            # capped trace must not read as a complete one
+            return
+        entry = {
+            "pc": pc,
+            "op": OPCODE_NAMES.get(op, f"opcode 0x{op:02x}"),
+            "gas": gas,
+            # EVM.depth is incremented before the frame runs, so the
+            # top-level call already reads 1 — geth's 1-based depth
+            "depth": depth,
+            "memSize": mem_size,
+        }
+        if self.with_stack:
+            entry["stack"] = [hex(v) for v in stack]
+        self.logs.append(entry)
+
+
+class PrestateTracer(CallTracer):
+    """prestateTracer (reference: eth/tracers/native/prestate.go):
+    records each touched account's balance/nonce/code and every
+    storage slot AS THEY WERE before the transaction — captured on
+    first touch via step inspection of state-reading opcodes."""
+
+    def __init__(self, state):
+        super().__init__()
+        self._state = state
+        self.accounts: dict = {}
+        self._addr_stack: list[bytes] = []
+
+    def touch(self, addr: bytes):
+        """Record an account's pre-tx snapshot on first sight; public
+        so the RPC layer can capture the SENDER before the replay's
+        nonce bump (enter() only fires after it)."""
+        self._touch(addr)
+
+    def _touch(self, addr: bytes):
+        key = "0x" + addr.hex()
+        if key in self.accounts:
+            return
+        self.accounts[key] = {
+            "balance": hex(self._state.balance(addr)),
+            "nonce": self._state.nonce(addr),
+            "code": "0x" + self._state.code(addr).hex(),
+            "storage": {},
+        }
+
+    def _touch_slot(self, addr: bytes, slot: bytes):
+        self._touch(addr)
+        entry = self.accounts["0x" + addr.hex()]["storage"]
+        k = "0x" + slot.hex()
+        if k not in entry:
+            entry[k] = hex(self._state.storage_get(addr, slot))
+
+    def enter(self, typ, frm, to, value, gas, data):
+        super().enter(typ, frm, to, value, gas, data)
+        self._touch(frm)
+        self._touch(to)
+        self._addr_stack.append(to)
+
+    def exit(self, ok, gas_left, output):
+        super().exit(ok, gas_left, output)
+        self._addr_stack.pop()
+
+    def step(self, pc, op, gas, depth, stack, mem_size):
+        if not self._addr_stack or not stack:
+            return
+        me = self._addr_stack[-1]
+        if op in (0x54, 0x55):  # SLOAD/SSTORE: slot on top of stack
+            self._touch_slot(me, (stack[-1] % 2**256).to_bytes(32, "big"))
+        elif op in (0x31, 0x3B, 0x3C, 0x3F):  # BALANCE/EXTCODE*
+            self._touch((stack[-1] % 2**160).to_bytes(20, "big"))
+        elif op in (0xF1, 0xF2, 0xF4, 0xFA) and len(stack) >= 2:
+            # CALL-family target (2nd from top): covers DELEGATECALL/
+            # CALLCODE code accounts, whose frames run under the
+            # CALLER's address and so never hit enter()
+            self._touch((stack[-2] % 2**160).to_bytes(20, "big"))
+
+
 class EVM:
     """The interpreter.  One instance per transaction."""
 
@@ -746,8 +867,15 @@ class EVM:
              value: int, calldata: bytes, gas: int, static: bool):
         f = Frame(code, gas)
         st, mem = f.stack, f.mem
+        # opcode-level tracing is opt-in per tracer (structLog): the
+        # attribute probe is hoisted out of the loop — the common
+        # CallTracer path must not pay per-opcode overhead
+        step = getattr(self.tracer, "step", None)
         while f.pc < len(code):
             op = code[f.pc]
+            if step is not None:
+                step(f.pc, op, f.gas, self.depth, f.stack,
+                     len(f.mem.data))
             f.pc += 1
             # PUSH0..PUSH32
             if 0x5F <= op <= 0x7F:

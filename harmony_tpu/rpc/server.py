@@ -535,29 +535,74 @@ class RPCServer:
     # -- debug namespace (reference: eth/tracers callTracer) ---------------
 
     def _traceTransaction(self, params, v2):
-        """Re-execute a mined transaction with the CallTracer against
-        its parent state (reference: debug_traceTransaction)."""
+        """Re-execute a mined transaction under a tracer against its
+        parent state (reference: debug_traceTransaction + eth/tracers).
+        The tracer option selects callTracer / prestateTracer; with no
+        option the geth-default opcode structLogs come back."""
         tx_hash = bytes.fromhex(params[0][2:])
         found = self.hmy.get_transaction(tx_hash)
         if found is None:
             return None
         num, _idx, tx = found
-        from ..core.vm import EVM, CallTracer, Env
+        from ..core.vm import (
+            EVM, CallTracer, Env, PrestateTracer, StructLogTracer,
+        )
 
+        opts = params[1] if len(params) > 1 and params[1] else {}
+        which = opts.get("tracer", "")
         state = self.hmy.chain.state_at(num - 1).copy()
         chain_id = self.hmy.chain_id()
         sender = tx.sender(chain_id)
         env = Env(block_num=num, chain_id=chain_id,
                   shard_id=self.hmy.shard_id())
-        tracer = CallTracer()
+        if which == "callTracer":
+            tracer = CallTracer()
+        elif which == "prestateTracer":
+            tracer = PrestateTracer(state)
+        elif not which:
+            tracer = StructLogTracer(
+                with_stack=not (
+                    opts.get("disableStack") or opts.get("disable_stack")
+                ),
+            )
+        else:
+            raise ValueError(f"unknown tracer {which!r}")
         evm = EVM(state, env, origin=sender, gas_price=tx.gas_price,
                   tracer=tracer)
+        if which == "prestateTracer":
+            # capture the sender BEFORE the replay's nonce bump —
+            # enter() only fires inside the call
+            tracer.touch(sender)
         state.set_nonce(sender, tx.nonce + 1)
+        # replay with the same budget the processor gave the VM:
+        # intrinsic gas is charged up front (state_processor.py)
+        from ..core.state_processor import intrinsic_gas
+
+        intrinsic = intrinsic_gas(tx)
+        budget = max(tx.gas_limit - intrinsic, 0)
         if tx.to is None:
-            evm.create(sender, tx.value, tx.data, tx.gas_limit)
+            ok, gas_left, created = evm.create(
+                sender, tx.value, tx.data, budget
+            )[:3]
+            # geth's returnValue for creation is the DEPLOYED code
+            out = state.code(created) if ok and created else b""
         else:
-            evm.call(sender, tx.to, tx.value, tx.data, tx.gas_limit)
-        return tracer.root
+            ok, gas_left, out = evm.call(
+                sender, tx.to, tx.value, tx.data, budget
+            )[:3]
+        if which == "callTracer":
+            return tracer.root
+        if which == "prestateTracer":
+            return tracer.accounts
+        result = {
+            "gas": intrinsic + (budget - gas_left),
+            "failed": not ok,
+            "returnValue": out.hex(),
+            "structLogs": tracer.logs,
+        }
+        if tracer.truncated:
+            result["truncated"] = True
+        return result
 
     # -- staking reads (reference: rpc staking.go) --------------------------
 

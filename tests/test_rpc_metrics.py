@@ -230,12 +230,13 @@ def test_rpc_receipt_logs_filters_and_call(stack):
     }])["result"]
     assert 21000 <= int(est, 16) < 60_000
 
-    # code/storage reads + call tracer
+    # code/storage reads + call tracer (geth semantics: callTracer is
+    # an explicit option; the bare call returns structLogs)
     code = _call(srv.port, "eth_getCode", [ca])["result"]
     assert code == "0x" + runtime.hex()
     trace = _call(
         srv.port, "debug_traceTransaction",
-        ["0x" + invoke.hash(CHAIN_ID).hex()],
+        ["0x" + invoke.hash(CHAIN_ID).hex(), {"tracer": "callTracer"}],
     )["result"]
     assert trace["type"] == "CALL" and trace["to"] == ca[2:].lower()
 
@@ -357,3 +358,70 @@ def test_eth_get_proof(stack):
         bytes.fromhex(got["stateRoot"][2:]),
         keccak256(b"\xef" * 20), proof,
     ) == b""
+
+
+def test_debug_tracers_structlog_and_prestate(stack):
+    """debug_traceTransaction tracer options (reference: eth/tracers):
+    default = geth structLogs; prestateTracer = touched accounts and
+    slots as they were before the tx; callTracer unchanged."""
+    srv, hmy, keys, to, _ = stack
+    chain = hmy.chain
+    worker = Worker(chain, hmy.tx_pool)
+    # a contract that writes storage: sstore(key=5, value=7); stop
+    runtime = bytes([0x60, 0x07, 0x60, 0x05, 0x55, 0x00])
+    init = bytes([
+        0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+        0x60, len(runtime), 0x60, 0x00, 0xF3,
+    ]) + runtime
+    deploy = Transaction(
+        nonce=chain.state().nonce(keys[0].address()), gas_price=1,
+        gas_limit=500_000, shard_id=0, to_shard=0, to=None, value=0,
+        data=init,
+    ).sign(keys[0], CHAIN_ID)
+    hmy.tx_pool.add(deploy)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+    rc = _call(srv.port, "eth_getTransactionReceipt",
+               ["0x" + deploy.hash(CHAIN_ID).hex()])["result"]
+    ca = rc["contractAddress"]
+    invoke = Transaction(
+        nonce=chain.state().nonce(keys[0].address()), gas_price=1,
+        gas_limit=200_000, shard_id=0, to_shard=0,
+        to=bytes.fromhex(ca[2:]), value=0,
+    ).sign(keys[0], CHAIN_ID)
+    hmy.tx_pool.add(invoke)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+    txh = "0x" + invoke.hash(CHAIN_ID).hex()
+
+    # default: geth-shaped structLogs, opcode names + 1-based depth;
+    # the traced gas must AGREE with the mined receipt
+    rc2 = _call(srv.port, "eth_getTransactionReceipt", [txh])["result"]
+    got = _call(srv.port, "debug_traceTransaction", [txh])["result"]
+    assert not got["failed"]
+    assert got["gas"] == int(rc2["gasUsed"], 16)
+    ops = [l["op"] for l in got["structLogs"]]
+    assert ops == ["PUSH1", "PUSH1", "SSTORE", "STOP"]
+    assert got["structLogs"][0]["depth"] == 1
+    assert got["structLogs"][2]["stack"] == ["0x7", "0x5"]
+
+    # prestateTracer: the slot's PRE value (0) and the sender's
+    # PRE-transaction nonce (not the replay's bumped one)
+    pre = _call(srv.port, "debug_traceTransaction",
+                [txh, {"tracer": "prestateTracer"}])["result"]
+    slot_key = "0x" + (5).to_bytes(32, "big").hex()
+    assert pre[ca]["storage"][slot_key] == "0x0"
+    sender_pre = pre["0x" + keys[0].address().hex()]
+    assert int(sender_pre["balance"], 16) > 0
+    assert sender_pre["nonce"] == invoke.nonce
+
+    # callTracer still answers
+    ct = _call(srv.port, "debug_traceTransaction",
+               [txh, {"tracer": "callTracer"}])["result"]
+    assert ct["type"] == "CALL"
+    assert ct["to"] in (ca[2:].lower(), ca[2:])
+    # unknown tracer is an error
+    assert "error" in _call(srv.port, "debug_traceTransaction",
+                            [txh, {"tracer": "bogusTracer"}])
