@@ -45,6 +45,30 @@ class Harmony:
     def get_nonce(self, address: bytes) -> int:
         return self.chain.state().nonce(address)
 
+    def get_cx_receipt_by_hash(self, tx_hash: bytes):
+        """The outgoing cross-shard receipt a source-shard tx produced
+        (reference: rpc hmyv2_getCXReceiptByHash).  Also the operator's
+        re-export handle when the committing leader's broadcast was
+        lost: any validator holds the same rawdb batch."""
+        from ..core import rawdb
+
+        num = rawdb.read_receipt_block_num(self.chain.db, tx_hash)
+        if num is None:
+            return None
+        block = self.chain.block_by_number(num)
+        if block is None:
+            return None
+        tx = next(
+            (t for t in block.transactions
+             if t.hash(self.chain.config.chain_id) == tx_hash), None
+        )
+        if tx is None or not tx.is_cross_shard():
+            return None
+        for cx in self.chain.outgoing_cx(tx.to_shard, num):
+            if cx.tx_hash == tx_hash:
+                return cx
+        return None
+
     def get_proof(self, address: bytes, slots: list,
                   block_num: int | None = None):
         """eth_getProof backing: (mpt_root, account leaf, account

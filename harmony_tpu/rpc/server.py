@@ -482,6 +482,30 @@ class RPCServer:
     def _gasPrice(self, params, v2):
         return self._int(1_000_000_000, v2)  # min gas price placeholder
 
+    def _getCXReceiptByHash(self, params, v2):
+        """hmyv2_getCXReceiptByHash (reference: rpc/transaction.go):
+        the cross-shard receipt minted by a source-shard tx."""
+        cx = self.hmy.get_cx_receipt_by_hash(
+            bytes.fromhex(params[0][2:])
+        )
+        if cx is None:
+            return None
+        header = self.hmy.header_by_number(cx.block_num)
+        # keys per the reference's rpc CxReceipt json tags
+        # (rpc/harmony/v2/types.go:253-262)
+        return {
+            "blockHash": "0x" + (
+                header.hash().hex() if header else "00" * 32
+            ),
+            "blockNumber": self._int(cx.block_num, v2),
+            "hash": "0x" + cx.tx_hash.hex(),
+            "from": "0x" + cx.sender.hex(),
+            "to": "0x" + cx.to.hex(),
+            "shardID": cx.from_shard,
+            "toShardID": cx.to_shard,
+            "value": self._int(cx.amount, v2),
+        }
+
     def _getProof(self, params, v2):
         """eth_getProof (reference: the go-ethereum GetProof RPC the
         fork carries): Merkle account + storage proofs against the

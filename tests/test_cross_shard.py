@@ -168,3 +168,44 @@ def test_cx_pool_caps_and_filtering():
         spent=lambda fs, num: (fs, num) == (0, 1),
     )
     assert tracked.add_batch(proof.encode()) == 0
+
+
+def test_cx_receipt_by_hash_rpc():
+    """hmyv2_getCXReceiptByHash (reference: rpc/transaction.go) — the
+    re-export handle any validator can serve when the leader's cx
+    broadcast was lost."""
+    import http.client
+    import json
+
+    from harmony_tpu.hmy.facade import Harmony
+    from harmony_tpu.rpc import RPCServer
+
+    c0, c1, keys = _two_shards()
+    sender = keys[0]
+    to = b"\x0c" * 20
+    block0 = _send_cross_shard(c0, sender, to, 4321)
+    tx = block0.transactions[0]
+    hmy = Harmony(c0)
+    assert hmy.get_cx_receipt_by_hash(tx.hash(CHAIN_ID)).amount == 4321
+    assert hmy.get_cx_receipt_by_hash(b"\x00" * 32) is None
+    srv = RPCServer(hmy, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("POST", "/", json.dumps({
+            "jsonrpc": "2.0", "id": 1,
+            "method": "hmyv2_getCXReceiptByHash",
+            "params": ["0x" + tx.hash(CHAIN_ID).hex()],
+        }), {"Content-Type": "application/json"})
+        got = json.loads(conn.getresponse().read())["result"]
+        conn.close()
+        # reference json tags: rpc/harmony/v2/types.go CxReceipt
+        assert got["value"] == 4321 and got["toShardID"] == 1
+        assert got["shardID"] == 0
+        assert got["hash"] == "0x" + tx.hash(CHAIN_ID).hex()
+        assert got["to"] == "0x" + to.hex()
+        assert got["blockHash"] == "0x" + (
+            c0.header_by_number(1).hash().hex()
+        )
+    finally:
+        srv.stop()
