@@ -147,7 +147,10 @@ class RosettaServer:
                     {"status": "success", "successful": True},
                     {"status": "failure", "successful": False},
                 ],
-                "operation_types": ["NativeTransfer", "Gas"],
+                "operation_types": [
+                    "NativeTransfer", "Gas",
+                    "Delegate", "Undelegate", "CollectRewards",
+                ],
                 "errors": [
                     {"code": 1, "message": "parse error"},
                     {"code": 2, "message": "no route"},
@@ -204,6 +207,18 @@ class RosettaServer:
                 },
                 "operations": ops,
             })
+        for stx in block.staking_transactions:
+            # mined staking directives surface as their construction
+            # operation types (a reconciler must see the delegator's
+            # debit somewhere in the block)
+            txs.append({
+                "transaction_identifier": {
+                    "hash": "0x" + stx.hash(chain_id).hex()
+                },
+                "operations": self._tx_ops(
+                    1, stx, stx.sender(chain_id)
+                ),
+            })
         h = block.header
         return {
             "block": {
@@ -259,15 +274,43 @@ class RosettaServer:
             )
         return frm, to, value
 
-    def _tx_from_blob(self, hexstr: str):
-        from .core import rawdb
+    # staking intents (reference: rosetta/common/operations.go
+    # Delegate/Undelegate/CollectRewards + their OperationMetadata)
+    _STAKING_OPS = {"Delegate", "Undelegate", "CollectRewards"}
 
-        return rawdb.decode_tx(self._addr(hexstr))
-
-    def _tx_blob(self, tx) -> str:
-        from .core import rawdb
-
-        return "0x" + rawdb.encode_tx(tx, self.hmy.chain_id()).hex()
+    def _ops_to_intent(self, ops: list):
+        """Either ("transfer", frm, to, value) or a one-op staking
+        intent ("delegate"|"undelegate", delegator, validator, amount)
+        / ("collect", delegator, None, 0)."""
+        staking = [op for op in ops if op.get("type") in self._STAKING_OPS]
+        if not staking:
+            frm, to, value = self._ops_to_transfer(ops)
+            return ("transfer", frm, to, value)
+        if len(ops) != 1:
+            raise ValueError("a staking intent is exactly one operation")
+        op = staking[0]
+        delegator = self._addr(op["account"]["address"])
+        if op["type"] == "CollectRewards":
+            return ("collect", delegator, None, 0)
+        meta = op.get("metadata") or {}
+        if "validatorAddress" not in meta:
+            raise ValueError(
+                f"{op['type']} needs metadata.validatorAddress"
+            )
+        validator = self._addr(meta["validatorAddress"])
+        amount = int(op["amount"]["value"])
+        if op["type"] == "Delegate":
+            if amount >= 0:
+                raise ValueError(
+                    "Delegate debits the delegator: amount must be "
+                    "negative"
+                )
+            return ("delegate", delegator, validator, -amount)
+        if amount <= 0:
+            raise ValueError(
+                "Undelegate returns funds: amount must be positive"
+            )
+        return ("undelegate", delegator, validator, amount)
 
     def _cons_derive(self, req):
         from .crypto_ecdsa import decompress_pubkey, pub_to_address
@@ -292,13 +335,10 @@ class RosettaServer:
         }
 
     def _cons_preprocess(self, req):
-        frm, to, value = self._ops_to_transfer(req["operations"])
+        intent = self._ops_to_intent(req["operations"])
+        frm = intent[1]
         return {
-            "options": {
-                "from": "0x" + frm.hex(),
-                "to": "0x" + to.hex(),
-                "value": str(value),
-            },
+            "options": {"from": "0x" + frm.hex(), "kind": intent[0]},
             "required_public_keys": [
                 {"address": "0x" + frm.hex()}
             ],
@@ -307,7 +347,10 @@ class RosettaServer:
     def _cons_metadata(self, req):
         opts = req.get("options") or {}
         frm = self._addr(opts["from"])
-        gas_limit = 21_000
+        gas_limit = (
+            21_000 if opts.get("kind", "transfer") == "transfer"
+            else 50_000  # staking directives: intrinsic + validation
+        )
         gas_price = max(int(opts.get("gas_price", 0)), 1)
         return {
             "metadata": {
@@ -321,29 +364,108 @@ class RosettaServer:
             }],
         }
 
-    def _build_unsigned(self, ops: list, metadata: dict):
-        from .core.types import Transaction
+    # wire forms (rosetta-internal, like the reference's
+    # WrappedTransaction envelope carrying IsStaking):
+    #   unsigned_transaction = 0x || kind(1B) || sender(20B) || blob
+    #   signed_transaction   = 0x || kind(1B) || blob
+    # kind 0 = plain transfer, 1 = staking directive.  A sig-less tx
+    # cannot name its sender, so the unsigned form carries it for
+    # /construction/parse's intent round-trip.
 
-        frm, to, value = self._ops_to_transfer(ops)
+    def _build_unsigned(self, ops: list, metadata: dict):
+        from .core.types import Directive, StakingTransaction, Transaction
+
+        intent = self._ops_to_intent(ops)
+        kind, frm = intent[0], intent[1]
         shard = self.hmy.shard_id()
-        tx = Transaction(
+        if kind == "transfer":
+            _, _, to, value = intent
+            return 0, frm, Transaction(
+                nonce=int(metadata["nonce"]),
+                gas_price=int(metadata["gas_price"]),
+                gas_limit=int(metadata["gas_limit"]),
+                shard_id=shard, to_shard=shard,
+                to=to, value=value,
+            )
+        directive, fields = {
+            "delegate": (Directive.DELEGATE,
+                         lambda v, a: {"validator": v, "amount": a}),
+            "undelegate": (Directive.UNDELEGATE,
+                           lambda v, a: {"validator": v, "amount": a}),
+            "collect": (Directive.COLLECT_REWARDS, lambda v, a: {}),
+        }[kind]
+        return 1, frm, StakingTransaction(
             nonce=int(metadata["nonce"]),
             gas_price=int(metadata["gas_price"]),
             gas_limit=int(metadata["gas_limit"]),
-            shard_id=shard, to_shard=shard,
-            to=to, value=value,
+            directive=directive,
+            fields=fields(intent[2], intent[3]),
+            shard_id=shard,
         )
-        return frm, tx
+
+    def _encode_kind(self, kind: int, tx) -> bytes:
+        from .core import rawdb
+
+        enc = (rawdb.encode_staking_tx if kind else rawdb.encode_tx)
+        return bytes([kind]) + enc(tx, self.hmy.chain_id())
+
+    def _decode_kind(self, raw: bytes):
+        from .core import rawdb
+
+        kind = raw[0]
+        if kind not in (0, 1):
+            raise ValueError("unknown transaction kind")
+        dec = rawdb.decode_staking_tx if kind else rawdb.decode_tx
+        return kind, dec(raw[1:])
+
+    def _tx_ops(self, kind: int, tx, sender: bytes) -> list:
+        """A decoded tx back to its Rosetta operations."""
+        if kind == 0:
+            return [
+                {
+                    "operation_identifier": {"index": 0},
+                    "type": "NativeTransfer",
+                    "account": {"address": "0x" + sender.hex()},
+                    "amount": {"value": str(-tx.value),
+                               "currency": self._currency()},
+                },
+                {
+                    "operation_identifier": {"index": 1},
+                    "related_operations": [{"index": 0}],
+                    "type": "NativeTransfer",
+                    "account": {"address": "0x" + (tx.to or b"").hex()},
+                    "amount": {"value": str(tx.value),
+                               "currency": self._currency()},
+                },
+            ]
+        from .core.types import Directive
+
+        typ = {
+            Directive.DELEGATE: "Delegate",
+            Directive.UNDELEGATE: "Undelegate",
+            Directive.COLLECT_REWARDS: "CollectRewards",
+        }.get(tx.directive, tx.directive.name)
+        op = {
+            "operation_identifier": {"index": 0},
+            "type": typ,
+            "account": {"address": "0x" + sender.hex()},
+        }
+        if "amount" in tx.fields:
+            sign = "-" if tx.directive == Directive.DELEGATE else ""
+            op["amount"] = {"value": f"{sign}{tx.fields['amount']}",
+                            "currency": self._currency()}
+        if "validator" in tx.fields:
+            op["metadata"] = {
+                "validatorAddress": "0x" + tx.fields["validator"].hex()
+            }
+        return [op]
 
     def _cons_payloads(self, req):
-        frm, tx = self._build_unsigned(
+        kind, frm, tx = self._build_unsigned(
             req["operations"], req["metadata"]
         )
-        # the UNSIGNED wire form carries the sender address ahead of
-        # the tx blob (the reference wraps its unsigned tx the same
-        # way): a signature-less tx cannot name its sender, and
-        # /construction/parse must round-trip BOTH operations
-        unsigned = "0x" + frm.hex() + self._tx_blob(tx)[2:]
+        ek = self._encode_kind(kind, tx)
+        unsigned = "0x" + (ek[:1] + frm + ek[1:]).hex()
         return {
             "unsigned_transaction": unsigned,
             "payloads": [{
@@ -356,39 +478,19 @@ class RosettaServer:
     def _cons_parse(self, req):
         raw = self._addr(req["transaction"])
         if req.get("signed"):
-            from .core import rawdb
-
-            tx = rawdb.decode_tx(raw)
+            kind, tx = self._decode_kind(raw)
             sender = tx.sender(self.hmy.chain_id())
             signers = [{"address": "0x" + sender.hex()}]
         else:
-            sender, tx = raw[:20], self._tx_from_blob(
-                "0x" + raw[20:].hex()
-            )
-            sender, signers = bytes(sender), []
-        ops = [
-            {
-                "operation_identifier": {"index": 0},
-                "type": "NativeTransfer",
-                "account": {"address": "0x" + sender.hex()},
-                "amount": {"value": str(-tx.value),
-                           "currency": self._currency()},
-            },
-            {
-                "operation_identifier": {"index": 1},
-                "related_operations": [{"index": 0}],
-                "type": "NativeTransfer",
-                "account": {"address": "0x" + (tx.to or b"").hex()},
-                "amount": {"value": str(tx.value),
-                           "currency": self._currency()},
-            },
-        ]
-        return {"operations": ops,
+            sender = bytes(raw[1:21])
+            kind, tx = self._decode_kind(raw[:1] + raw[21:])
+            signers = []
+        return {"operations": self._tx_ops(kind, tx, sender),
                 "account_identifier_signers": signers}
 
     def _cons_combine(self, req):
         raw = self._addr(req["unsigned_transaction"])
-        tx = self._tx_from_blob("0x" + raw[20:].hex())  # drop sender
+        kind, tx = self._decode_kind(raw[:1] + raw[21:])  # drop sender
         sig = bytes.fromhex(req["signatures"][0]["hex_bytes"])
         if len(sig) != 65:
             raise ValueError("ecdsa_recovery signature must be 65 bytes")
@@ -396,10 +498,12 @@ class RosettaServer:
         # reject garbage before it can reach /submit: recovery must
         # yield SOME address (full sender checks happen at the pool)
         tx.sender(self.hmy.chain_id())
-        return {"signed_transaction": self._tx_blob(tx)}
+        return {
+            "signed_transaction": "0x" + self._encode_kind(kind, tx).hex()
+        }
 
     def _cons_hash(self, req):
-        tx = self._tx_from_blob(req["signed_transaction"])
+        _, tx = self._decode_kind(self._addr(req["signed_transaction"]))
         return {
             "transaction_identifier": {
                 "hash": "0x" + tx.hash(self.hmy.chain_id()).hex()
@@ -407,8 +511,13 @@ class RosettaServer:
         }
 
     def _cons_submit(self, req):
-        blob = self._addr(req["signed_transaction"])
-        tx_hash = self.hmy.send_raw_transaction(blob)
+        raw = self._addr(req["signed_transaction"])
+        if raw[0] not in (0, 1):
+            raise ValueError("unknown transaction kind")
+        if raw[0] == 1:
+            tx_hash = self.hmy.send_raw_staking_transaction(raw[1:])
+        else:
+            tx_hash = self.hmy.send_raw_transaction(raw[1:])
         return {
             "transaction_identifier": {"hash": "0x" + tx_hash.hex()}
         }

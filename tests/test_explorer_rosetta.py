@@ -237,3 +237,107 @@ def test_rosetta_construction_end_to_end(stack):
         assert len(pool) == 1  # nothing new landed
     finally:
         rs.stop()
+
+
+def test_rosetta_construction_staking_delegate(stack):
+    """Staking intents through the construction flow (reference:
+    rosetta construction_create.go staking operations): a Delegate
+    op becomes a signed StakingTransaction landing in the pool's
+    staking lane; parse round-trips the intent."""
+    chain, keys, to, _ = stack
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    hmy = Harmony(chain, pool)
+    rs = RosettaServer(hmy).start()
+    delegator = keys[0]
+    validator = b"\x1a" * 20
+    try:
+        ops = [{
+            "operation_identifier": {"index": 0},
+            "type": "Delegate",
+            "account": {"address": "0x" + delegator.address().hex()},
+            "amount": {"value": "-100000000000000000000",
+                       "currency": {"symbol": "ONE", "decimals": 18}},
+            "metadata": {"validatorAddress": "0x" + validator.hex()},
+        }]
+        status, pre = _post(rs.port, "/construction/preprocess",
+                            {"operations": ops})
+        assert status == 200 and pre["options"]["kind"] == "delegate"
+        status, meta = _post(rs.port, "/construction/metadata",
+                             {"options": pre["options"]})
+        assert status == 200
+        status, pay = _post(rs.port, "/construction/payloads",
+                            {"operations": ops,
+                             "metadata": meta["metadata"]})
+        assert status == 200
+
+        # unsigned parse round-trips the staking intent
+        status, up = _post(rs.port, "/construction/parse", {
+            "transaction": pay["unsigned_transaction"], "signed": False,
+        })
+        assert status == 200
+        op = up["operations"][0]
+        assert op["type"] == "Delegate"
+        assert op["metadata"]["validatorAddress"] == "0x" + validator.hex()
+        assert int(op["amount"]["value"]) == -(10**20)
+
+        sig = delegator.sign(bytes.fromhex(pay["payloads"][0]["hex_bytes"]))
+        status, comb = _post(rs.port, "/construction/combine", {
+            "unsigned_transaction": pay["unsigned_transaction"],
+            "signatures": [{"hex_bytes": sig.hex()}],
+        })
+        assert status == 200
+        status, parsed = _post(rs.port, "/construction/parse", {
+            "transaction": comb["signed_transaction"], "signed": True,
+        })
+        assert status == 200
+        assert parsed["account_identifier_signers"] == [
+            {"address": "0x" + delegator.address().hex()}
+        ]
+        status, hsh = _post(rs.port, "/construction/hash", {
+            "signed_transaction": comb["signed_transaction"],
+        })
+        assert status == 200
+        status, sub = _post(rs.port, "/construction/submit", {
+            "signed_transaction": comb["signed_transaction"],
+        })
+        assert status == 200
+        assert sub == hsh
+        pending = pool.pending(10)
+        assert len(pending) == 1 and pending[0][1] is True  # staking lane
+        assert pending[0][0].fields["amount"] == 10**20
+
+        # a POSITIVE Delegate amount is a mis-signed intent: rejected
+        bad_ops = [dict(ops[0], amount={
+            "value": "100", "currency": {"symbol": "ONE", "decimals": 18},
+        })]
+        status, _ = _post(rs.port, "/construction/preprocess",
+                          {"operations": bad_ops})
+        assert status == 500
+
+        # a MINED staking tx surfaces in the Data API /block response
+        # (reconcilers must see the delegator's debit): store a block
+        # carrying it and read it back
+        from harmony_tpu.chain.header import Header
+        from harmony_tpu.core import rawdb
+        from harmony_tpu.core.types import Block
+
+        stx = rawdb.decode_staking_tx(
+            bytes.fromhex(comb["signed_transaction"][2:])[1:]
+        )
+        blk = Block(None, transactions=[],
+                    staking_transactions=[stx], execution_order=[1])
+        blk.header = Header(shard_id=0, block_num=2, epoch=0, view_id=2,
+                            parent_hash=chain.current_header().hash(),
+                            timestamp=1000)
+        rawdb.write_block(chain.db, blk, CHAIN_ID)
+        status, got_blk = _post(rs.port, "/block",
+                                {"block_identifier": {"index": 2}})
+        assert status == 200
+        ops_out = got_blk["block"]["transactions"][-1]["operations"]
+        assert ops_out[0]["type"] == "Delegate"
+        assert int(ops_out[0]["amount"]["value"]) == -(10**20)
+        assert ops_out[0]["account"]["address"] == (
+            "0x" + delegator.address().hex()
+        )
+    finally:
+        rs.stop()
