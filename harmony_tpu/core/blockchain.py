@@ -312,7 +312,13 @@ class Blockchain:
             except (ValueError, IndexError) as e:
                 raise ChainError(f"bad cx proof header: {e}") from e
             key = (src.shard_id, src.block_num)
-            if key in seen or rawdb.is_cx_spent(self.db, *key):
+            spender = rawdb.cx_spender(self.db, *key)
+            if key in seen or (
+                spender is not None and spender != block.block_num
+            ):
+                # spent by a DIFFERENT block = double spend; spent by
+                # THIS block num = an idempotent re-insert (a replay
+                # sync walking over a fast-synced range)
                 raise ChainError("cx receipt batch double spend")
             seen.append(key)
             if not verify_cx_proof(proof, self.shard_id, self.engine,
@@ -375,9 +381,11 @@ class Blockchain:
         checks + batched seal verification + block/proof persistence,
         WITHOUT execution and without moving the head.  The head and
         state move together in :meth:`adopt_state` once the account
-        range download completes.  CX spent-marking for the skipped
-        range is deliberately not reconstructed — those batches were
-        consumed under consensus by the committee that sealed them.
+        range download completes.  The CX spent-set IS reconstructed —
+        each downloaded block's carried incoming_receipts name exactly
+        the (from_shard, num) batches its committee consumed, and the
+        blocks are seal-verified — so a fast-synced node later serving
+        as leader cannot re-propose an already-credited batch.
         """
         if not blocks:
             return 0
@@ -419,6 +427,19 @@ class Blockchain:
                     rawdb.write_block(self.db, b, self.config.chain_id)
                     if proof is not None:
                         rawdb.write_commit_sig(self.db, b.block_num, proof)
+                    for cxp in b.incoming_receipts:
+                        try:
+                            src = rawdb.decode_header(cxp.header_bytes)
+                        except (ValueError, IndexError,
+                                UnicodeDecodeError) as e:
+                            raise ChainError(
+                                f"bad cx proof header in fast block "
+                                f"{b.block_num}: {e}"
+                            ) from e
+                        rawdb.write_cx_spent(
+                            self.db, src.shard_id, src.block_num,
+                            spender=b.block_num,
+                        )
                 if block.header.shard_state:
                     elected = rawdb.decode_shard_state(
                         block.header.shard_state
@@ -503,7 +524,9 @@ class Blockchain:
             spent_keys = self.verify_incoming_receipts(block)
             state, result, elected = self._execute(block)
             for from_shard, num in spent_keys:
-                rawdb.write_cx_spent(self.db, from_shard, num)
+                rawdb.write_cx_spent(
+                    self.db, from_shard, num, spender=block.block_num
+                )
             if elected is not None:
                 rawdb.write_shard_state(self.db, elected.epoch, elected)
                 self._committee_cache.pop(elected.epoch, None)

@@ -324,12 +324,14 @@ def read_outgoing_cx(db, to_shard: int, num: int) -> list:
     return [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
 
 
-def write_cx_spent(db, from_shard: int, num: int):
+def write_cx_spent(db, from_shard: int, num: int, spender: int = 0):
     """Mark a source block's receipt batch consumed on this shard
     (reference: WriteCXReceiptsProofSpent — replaying the same proof in
-    a later block must fail as a double spend)."""
+    a later block must fail as a double spend).  ``spender`` records
+    WHICH local block consumed it, so re-inserting that exact block
+    (a replay sync over a fast-synced range) stays idempotent."""
     db.put(_CX_SPENT + from_shard.to_bytes(4, "little")
-           + num.to_bytes(8, "little"), b"\x01")
+           + num.to_bytes(8, "little"), spender.to_bytes(8, "little"))
 
 
 def delete_cx_spent(db, from_shard: int, num: int):
@@ -346,6 +348,22 @@ def is_cx_spent(db, from_shard: int, num: int) -> bool:
         _CX_SPENT + from_shard.to_bytes(4, "little")
         + num.to_bytes(8, "little")
     ) is not None
+
+
+def cx_spender(db, from_shard: int, num: int) -> int | None:
+    """The local block that consumed the batch, or None if unspent
+    (legacy b'\\x01' marks read as spender 1 — the localnet DBs that
+    predate the field only ever consumed at block 1... treat any
+    short value as 'unknown spender', which fails closed)."""
+    blob = db.get(
+        _CX_SPENT + from_shard.to_bytes(4, "little")
+        + num.to_bytes(8, "little")
+    )
+    if blob is None:
+        return None
+    if len(blob) != 8:
+        return -1  # unknown: never matches a real block num
+    return int.from_bytes(blob, "little")
 
 
 def encode_block(block: Block, chain_id: int) -> bytes:

@@ -209,3 +209,60 @@ def test_cx_receipt_by_hash_rpc():
         )
     finally:
         srv.stop()
+
+
+def test_fast_sync_reconstructs_cx_spent_set():
+    """A fast-synced destination node must know which source batches
+    its skipped range already credited (the downloaded blocks carry
+    them, seal-verified) — otherwise it could later lead a
+    double-credit proposal the network rejects."""
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+    from harmony_tpu.sync import Downloader
+
+    c0, c1, keys = _two_shards()
+    to = b"\x0c" * 20
+    _send_cross_shard(c0, keys[0], to, 777)
+    proof = make_cx_proof(c0, 1, 1, shard_count=2)
+    block1 = Worker(c1, None).propose_block(
+        view_id=1, incoming_receipts=[proof]
+    )
+    assert c1.insert_chain([block1], verify_seals=False) == 1
+    c1.write_commit_sig(1, b"\x01" * 96 + b"\x0f")
+
+    srv = SyncServer(c1)
+    try:
+        fresh = Blockchain(MemKV(), Genesis(
+            config=c1.config, shard_id=1, alloc=dict(c1.genesis.alloc),
+            committee=list(c1.genesis.committee),
+        ), blocks_per_epoch=16)
+        dl = Downloader(fresh, [SyncClient(srv.port)], batch=4,
+                        verify_seals=False)
+        res = dl.fast_sync()
+        assert res.inserted == 1 and not res.errors
+        assert fresh.state().balance(to) == 777
+        # the spent-set survived the skip: (shard 0, block 1) is spent
+        assert rawdb.is_cx_spent(fresh.db, 0, 1)
+        # and a replayed batch cannot enter a new block here
+        replay = Worker(fresh, None).propose_block(
+            view_id=2, incoming_receipts=[proof]
+        )
+        with pytest.raises(ChainError):
+            fresh.insert_chain([replay], verify_seals=False)
+
+        # an ABORTED fast sync (bodies persisted + spent-marked, states
+        # stage never completed) must not wedge the full-replay
+        # fallback: the same block re-consuming its own batches is
+        # idempotent, only a DIFFERENT block is a double spend
+        fresh2 = Blockchain(MemKV(), Genesis(
+            config=c1.config, shard_id=1, alloc=dict(c1.genesis.alloc),
+            committee=list(c1.genesis.committee),
+        ), blocks_per_epoch=16)
+        blk1 = c1.block_by_number(1)
+        fresh2.insert_headers_fast([blk1], verify_seals=False)
+        assert rawdb.is_cx_spent(fresh2.db, 0, 1)
+        assert fresh2.head_number == 0  # head never moved
+        assert fresh2.insert_chain([blk1], verify_seals=False) == 1
+        assert fresh2.state().balance(to) == 777
+    finally:
+        srv.close()
