@@ -303,6 +303,15 @@ def build_node(cfg: dict):
     else:
         reg_epoch_chain = None
     pool = TxPool(genesis.config.chain_id, cfg["shard_id"], chain.state)
+    if not cfg["in_memory"]:
+        # locally submitted txs survive restarts (reference:
+        # tx_journal.go; rotated at every commit boundary)
+        restored = pool.open_journal(os.path.join(
+            cfg["datadir"], f"shard{cfg['shard_id']}.txjournal"
+        ))
+        if restored:
+            log = get_logger("pool", shard=cfg["shard_id"])
+            log.info("tx journal replayed", restored=restored)
 
     keys = load_node_bls_keys(cfg, dev_bls)
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 
 PRICE_BUMP_PCT = 10          # reference: DefaultTxPoolConfig.PriceBump
@@ -45,6 +46,7 @@ class _Entry:
     sender: bytes
     is_staking: bool
     added_at: float
+    local: bool = False  # RPC-submitted (journaled) vs gossip
 
 
 class TxPool:
@@ -69,6 +71,14 @@ class TxPool:
         # the pool is shared between the consensus pump and RPC server
         # threads (sendRawTransaction) — every public method locks
         self._lock = threading.RLock()
+        self._journal = None  # open file handle once open_journal runs
+        self._journal_path: str | None = None
+        # admission ring for push subscribers (rpc/ws.py
+        # newPendingTransactions): a tx that enters AND leaves the
+        # pool between two polls must still be notified, so pushers
+        # read this monotonic log instead of diffing snapshots
+        self._add_seq = 0
+        self._recent_adds: deque = deque(maxlen=4096)
 
     # -- tier classification -------------------------------------------------
 
@@ -152,7 +162,9 @@ class TxPool:
             if tx.gas_price < max(bump, old.tx.gas_price + 1):
                 raise PoolError("replacement underpriced")
             slots[tx.nonce] = _Entry(tx, sender, is_staking,
-                                     time.monotonic())
+                                     time.monotonic(),
+                                     local=old.local)
+            self._record_add(tx, is_staking)
             return sender
         # per-sender caps: executable run vs queued tail
         exec_n = self._sender_exec_count(state, sender)
@@ -169,6 +181,7 @@ class TxPool:
                 raise PoolError("pool full (newcomer underpriced)")
         slots[tx.nonce] = _Entry(tx, sender, is_staking, time.monotonic())
         self._count += 1
+        self._record_add(tx, is_staking)
         return sender
 
     # -- selection ---------------------------------------------------------
@@ -264,9 +277,119 @@ class TxPool:
         with self._lock:
             return self._stats_unlocked()
 
-    def add(self, tx, is_staking: bool = False) -> bytes:
+    def add(self, tx, is_staking: bool = False,
+            local: bool = False) -> bytes:
         with self._lock:
-            return self._add_unlocked(tx, is_staking)
+            sender = self._add_unlocked(tx, is_staking)
+            if local:
+                entry = self._by_sender[sender][tx.nonce]
+                entry.local = True
+                if self._journal is not None:
+                    try:
+                        self._journal_append(tx, is_staking)
+                        self._journal.flush()
+                    except OSError:
+                        # the journal is best-effort persistence: a
+                        # full disk must not fail an ADMITTED tx
+                        pass
+            return sender
+
+    def _record_add(self, tx, is_staking: bool):
+        self._add_seq += 1
+        self._recent_adds.append(
+            (self._add_seq, tx.hash(self.chain_id))
+        )
+
+    @property
+    def add_seq(self) -> int:
+        with self._lock:
+            return self._add_seq
+
+    def adds_since(self, seq: int):
+        """(latest_seq, [tx hashes admitted after ``seq``]) — the push
+        feed for newPendingTransactions subscribers."""
+        with self._lock:
+            return self._add_seq, [
+                h for s, h in self._recent_adds if s > seq
+            ]
+
+    # -- local tx journal (reference: core/tx_journal.go — locally
+    # submitted txs survive a node restart; remote gossip does not) ---------
+
+    _JOURNAL_ROTATE_BYTES = 1 << 20  # rewrite when the file outgrows this
+
+    def open_journal(self, path: str) -> int:
+        """Attach a journal file; replays any existing entries into the
+        pool first (invalid/stale entries are dropped), then rewrites
+        it with the survivors.  Returns how many txs were restored."""
+        from . import rawdb
+
+        restored = 0
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            blob = b""
+        with self._lock:
+            i = 0
+            while i + 5 <= len(blob):
+                kind = blob[i]
+                ln = int.from_bytes(blob[i + 1:i + 5], "little")
+                i += 5
+                raw = blob[i:i + ln]
+                i += ln
+                if len(raw) < ln or kind not in (0, 1):
+                    break  # torn tail (crash mid-append): discard rest
+                try:
+                    tx = (rawdb.decode_staking_tx if kind
+                          else rawdb.decode_tx)(raw)
+                    sender = self._add_unlocked(tx, bool(kind))
+                    self._by_sender[sender][tx.nonce].local = True
+                    restored += 1
+                except (ValueError, IndexError):
+                    continue  # applied/stale/corrupt entries drop out
+            self._journal_path = path
+            self._rotate_journal_unlocked()
+        return restored
+
+    def _journal_append(self, tx, is_staking: bool, fh=None):
+        from . import rawdb
+
+        enc = (rawdb.encode_staking_tx if is_staking
+               else rawdb.encode_tx)(tx, self.chain_id)
+        (fh or self._journal).write(
+            bytes([1 if is_staking else 0])
+            + len(enc).to_bytes(4, "little") + enc
+        )
+
+    def _rotate_journal_unlocked(self):
+        """Rewrite the journal with only the LOCAL txs still in the
+        pool, via tmp + atomic replace: a crash mid-rewrite must not
+        lose the previous journal (the reference rotates on demand to
+        bound file growth)."""
+        import os
+
+        if self._journal_path is None:
+            return
+        try:
+            if self._journal is not None:
+                self._journal.close()
+            tmp = self._journal_path + ".tmp"
+            with open(tmp, "wb") as fh:
+                for sender_txs in self._by_sender.values():
+                    for entry in sender_txs.values():
+                        if entry.local:
+                            self._journal_append(
+                                entry.tx, entry.is_staking, fh=fh
+                            )
+            os.replace(tmp, self._journal_path)
+            self._journal = open(self._journal_path, "ab")
+        except OSError:
+            self._journal = None  # best-effort: run without a journal
+
+    def rotate_journal(self):
+        with self._lock:
+            self._rotate_journal_unlocked()
 
     def pending(self, max_txs: int = 0):
         with self._lock:
@@ -278,7 +401,20 @@ class TxPool:
 
     def drop_applied(self):
         with self._lock:
-            return self._drop_applied_unlocked()
+            n = self._drop_applied_unlocked()
+            if n and self._journal is not None:
+                # rotate only when the file outgrew its cap: a rewrite
+                # is O(pool) disk work and this runs on the consensus
+                # commit path
+                try:
+                    oversized = (
+                        self._journal.tell() > self._JOURNAL_ROTATE_BYTES
+                    )
+                except (OSError, ValueError):
+                    oversized = True
+                if oversized:
+                    self._rotate_journal_unlocked()
+            return n
 
     def evict_stale(self, now: float | None = None):
         with self._lock:

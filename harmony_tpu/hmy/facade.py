@@ -342,12 +342,14 @@ class Harmony:
         if self.tx_pool is None:
             raise PoolError("node has no transaction pool")
         tx = rawdb.decode_tx(blob)
-        self.tx_pool.add(tx)
+        # RPC-submitted txs are LOCAL: journaled across restarts
+        # (reference: tx_journal.go locals semantics)
+        self.tx_pool.add(tx, local=True)
         return tx.hash(self.chain.config.chain_id)
 
     def send_raw_staking_transaction(self, blob: bytes) -> bytes:
         if self.tx_pool is None:
             raise PoolError("node has no transaction pool")
         tx = rawdb.decode_staking_tx(blob)
-        self.tx_pool.add(tx, is_staking=True)
+        self.tx_pool.add(tx, is_staking=True, local=True)
         return tx.hash(self.chain.config.chain_id)

@@ -202,7 +202,8 @@ class WSServer:
         if method.endswith("_subscribe"):
             params = req.get("params") or []
             kind = params[0] if params else ""
-            if kind not in ("newHeads", "logs"):
+            if kind not in ("newHeads", "logs",
+                            "newPendingTransactions"):
                 return self.rpc._error(
                     req.get("id"), -32602, f"unsupported: {kind}"
                 )
@@ -212,6 +213,11 @@ class WSServer:
                     "kind": kind,
                     "criteria": params[1] if len(params) > 1 else {},
                     "last_block": self.rpc.hmy.block_number(),
+                    # pending-tx subs push only txs admitted AFTER the
+                    # subscription (geth semantics); the pool's
+                    # admission ring catches txs that enter and leave
+                    # within one poll interval
+                    "seq": self._pool_seq(),
                 }
             return {"jsonrpc": "2.0", "id": req.get("id"),
                     "result": sub_id}
@@ -223,11 +229,23 @@ class WSServer:
                     "result": ok is not None}
         return self.rpc.dispatch(req)
 
+    def _pool_seq(self) -> int:
+        pool = getattr(self.rpc.hmy, "tx_pool", None)
+        return pool.add_seq if pool is not None else 0
+
     def _push_round(self, sock, subs, lock):
         with lock:
             items = list(subs.items())
         head = self.rpc.hmy.block_number()
         for sub_id, sub in items:
+            if sub["kind"] == "newPendingTransactions":
+                pool = getattr(self.rpc.hmy, "tx_pool", None)
+                if pool is None:
+                    continue
+                sub["seq"], hashes = pool.adds_since(sub["seq"])
+                for h in hashes:
+                    self._notify(sock, sub_id, "0x" + h.hex())
+                continue
             since = sub["last_block"]
             if head <= since:
                 continue

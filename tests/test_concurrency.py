@@ -139,3 +139,51 @@ def test_host_concurrent_publish_no_loss():
     finally:
         a.close()
         b.close()
+
+
+def test_tx_pool_journal_restores_local_txs(tmp_path):
+    """reference: core/tx_journal.go — LOCAL (RPC-submitted) txs
+    survive a restart via the journal; remote/gossip txs and applied
+    txs do not come back."""
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.core.types import Transaction
+
+    CHAIN_ID = 2
+    genesis, keys, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    path = str(tmp_path / "pool.txjournal")
+
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    assert pool.open_journal(path) == 0
+    to = b"\x0f" * 20
+    local1 = Transaction(nonce=0, gas_price=1, gas_limit=25_000,
+                         shard_id=0, to_shard=0, to=to,
+                         value=11).sign(keys[0], CHAIN_ID)
+    local2 = Transaction(nonce=1, gas_price=1, gas_limit=25_000,
+                         shard_id=0, to_shard=0, to=to,
+                         value=22).sign(keys[0], CHAIN_ID)
+    remote = Transaction(nonce=0, gas_price=1, gas_limit=25_000,
+                         shard_id=0, to_shard=0, to=to,
+                         value=33).sign(keys[1], CHAIN_ID)
+    pool.add(local1, local=True)
+    pool.add(local2, local=True)
+    pool.add(remote)  # gossip: not journaled
+
+    # "restart": a new pool over the same journal file
+    pool2 = TxPool(CHAIN_ID, 0, chain.state)
+    assert pool2.open_journal(path) == 2
+    hashes = {t.hash(CHAIN_ID) for t, _ in pool2.pending(10)}
+    assert hashes == {local1.hash(CHAIN_ID), local2.hash(CHAIN_ID)}
+
+    # once mined, drop_applied rotates them OUT of the journal
+    from harmony_tpu.node.worker import Worker
+
+    worker = Worker(chain, pool2)
+    block = worker.propose_block(view_id=1)
+    chain.insert_chain([block], verify_seals=False)
+    pool2.drop_applied()
+    pool3 = TxPool(CHAIN_ID, 0, chain.state)
+    assert pool3.open_journal(path) == 0

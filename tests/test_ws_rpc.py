@@ -95,3 +95,43 @@ def test_ws_ping_pong_and_close():
         sock.close()
     finally:
         ws.stop()
+
+
+def test_ws_new_pending_transactions_subscription():
+    """eth_subscribe("newPendingTransactions") pushes hashes of txs
+    that arrive in the pool AFTER the subscription (geth semantics)."""
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.core.types import Transaction
+
+    genesis, keys, _bls = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    # pre-existing tx: must NOT be pushed
+    pre = Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0, to_shard=0,
+        to=b"\x0e" * 20, value=1,
+    ).sign(keys[0], CHAIN_ID)
+    pool.add(pre)
+    hmy = Harmony(chain, pool)
+    rpc = RPCServer(hmy)
+    ws = WSServer(rpc, poll_interval=0.05).start()
+    try:
+        sock = _ws_connect(ws.port)
+        out = _rpc_ws(sock, "eth_subscribe", ["newPendingTransactions"])
+        sub_id = out["result"]
+        tx = Transaction(
+            nonce=1, gas_price=1, gas_limit=25_000, shard_id=0,
+            to_shard=0, to=b"\x0e" * 20, value=2,
+        ).sign(keys[0], CHAIN_ID)
+        hmy.send_raw_transaction(rawdb.encode_tx(tx, CHAIN_ID))
+        sock.settimeout(5)
+        op, payload = read_frame(sock)
+        note = json.loads(payload)
+        assert note["params"]["subscription"] == sub_id
+        assert note["params"]["result"] == (
+            "0x" + tx.hash(CHAIN_ID).hex()
+        )
+        sock.close()
+    finally:
+        ws.stop()
