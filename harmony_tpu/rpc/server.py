@@ -482,6 +482,63 @@ class RPCServer:
     def _gasPrice(self, params, v2):
         return self._int(1_000_000_000, v2)  # min gas price placeholder
 
+    def _pendingTransactions(self, params, v2):
+        """hmy_pendingTransactions (reference: rpc/transaction.go
+        PendingTransactions): the pool's executable plain txs."""
+        pool = getattr(self.hmy, "tx_pool", None)
+        if pool is None:
+            return []
+        out = []
+        for tx, is_staking in pool.pending():
+            if is_staking:
+                continue
+            d = self._tx_dict(tx, 0, 0, v2)
+            # unmined: null placement, per the reference/eth semantics
+            d["blockNumber"] = None
+            d["transactionIndex"] = None
+            out.append(d)
+        return out
+
+    def _pendingStakingTransactions(self, params, v2):
+        """hmy_pendingStakingTransactions (reference: the staking
+        lane of PendingTransactions)."""
+        pool = getattr(self.hmy, "tx_pool", None)
+        if pool is None:
+            return []
+        chain_id = self.hmy.chain_id()
+        return [
+            {
+                "hash": "0x" + tx.hash(chain_id).hex(),
+                "nonce": self._int(tx.nonce, v2),
+                "from": "0x" + tx.sender(chain_id).hex(),
+                "type": tx.directive.name,
+                "gas": self._int(tx.gas_limit, v2),
+                "gasPrice": self._int(tx.gas_price, v2),
+            }
+            for tx, is_staking in pool.pending()
+            if is_staking
+        ]
+
+    def _traceBlockByNumber(self, params, v2):
+        """debug_traceBlockByNumber: every tx of a block under the
+        selected tracer (reference: eth/tracers API)."""
+        num = _block_num(params[0], self.hmy.block_number())
+        block = self.hmy.block_by_number(num)
+        if block is None:
+            return None
+        opts = params[1] if len(params) > 1 and params[1] else {}
+        chain_id = self.hmy.chain_id()
+        # ONE parent state, evolved tx by tx: intra-block dependencies
+        # (a tx reading its predecessor's writes) trace as executed
+        state = self.hmy.chain.state_at(num - 1).copy()
+        out = []
+        for tx in block.transactions:
+            out.append({
+                "txHash": "0x" + tx.hash(chain_id).hex(),
+                "result": self._trace_core(tx, num, state, opts),
+            })
+        return out
+
     def _getCXReceiptByHash(self, params, v2):
         """hmyv2_getCXReceiptByHash (reference: rpc/transaction.go):
         the cross-shard receipt minted by a source-shard tx."""
@@ -568,13 +625,19 @@ class RPCServer:
         if found is None:
             return None
         num, _idx, tx = found
+        opts = params[1] if len(params) > 1 and params[1] else {}
+        state = self.hmy.chain.state_at(num - 1).copy()
+        return self._trace_core(tx, num, state, opts)
+
+    def _trace_core(self, tx, num: int, state, opts: dict):
+        """One tx replayed under a tracer ON the given state — the
+        state EVOLVES (value moves, storage writes, nonce bump, fee
+        debit), so a block-level caller chains txs cumulatively."""
         from ..core.vm import (
             EVM, CallTracer, Env, PrestateTracer, StructLogTracer,
         )
 
-        opts = params[1] if len(params) > 1 and params[1] else {}
         which = opts.get("tracer", "")
-        state = self.hmy.chain.state_at(num - 1).copy()
         chain_id = self.hmy.chain_id()
         sender = tx.sender(chain_id)
         env = Env(block_num=num, chain_id=chain_id,
@@ -614,6 +677,12 @@ class RPCServer:
             ok, gas_left, out = evm.call(
                 sender, tx.to, tx.value, tx.data, budget
             )[:3]
+        # fee debit, so a later tx in a cumulative block replay sees
+        # the sender's true post-tx balance (the processor does this
+        # on the real path)
+        state.sub_balance(
+            sender, (intrinsic + budget - gas_left) * tx.gas_price
+        )
         if which == "callTracer":
             return tracer.root
         if which == "prestateTracer":

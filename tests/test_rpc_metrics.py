@@ -425,3 +425,34 @@ def test_debug_tracers_structlog_and_prestate(stack):
     # unknown tracer is an error
     assert "error" in _call(srv.port, "debug_traceTransaction",
                             [txh, {"tracer": "bogusTracer"}])
+
+
+def test_pending_transactions_and_trace_block(stack):
+    """hmy_pendingTransactions + debug_traceBlockByNumber (reference:
+    rpc/transaction.go PendingTransactions, eth/tracers block API)."""
+    srv, hmy, keys, to, _ = stack
+    nonce = hmy.chain.state().nonce(keys[0].address())
+    tx = Transaction(
+        nonce=nonce, gas_price=1, gas_limit=25_000, shard_id=0,
+        to_shard=0, to=to, value=9,
+    ).sign(keys[0], CHAIN_ID)
+    hmy.send_raw_transaction(rawdb.encode_tx(tx, CHAIN_ID))
+    pend = _call(srv.port, "hmy_pendingTransactions")["result"]
+    mine = [p for p in pend
+            if p["hash"] == "0x" + tx.hash(CHAIN_ID).hex()]
+    assert mine and mine[0]["blockNumber"] is None  # unmined = null
+    assert _call(srv.port,
+                 "hmy_pendingStakingTransactions")["result"] == []
+    # drain so later fixture users see a clean pool
+    block = Worker(hmy.chain, hmy.tx_pool).propose_block(
+        view_id=hmy.chain.head_number + 1
+    )
+    hmy.chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+
+    traced = _call(srv.port, "debug_traceBlockByNumber",
+                   ["0x1", {"tracer": "callTracer"}])["result"]
+    assert len(traced) == 1
+    assert traced[0]["result"]["type"] == "CALL"
+    assert _call(srv.port, "debug_traceBlockByNumber",
+                 ["0x7f"])["result"] is None
