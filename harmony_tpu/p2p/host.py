@@ -194,9 +194,20 @@ class TCPHost(Host):
     SCORE_DECAY_PER_S = 0.5  # forgiveness rate for honest mistakes
 
     def __init__(self, name: str = "", listen_port: int = 0,
-                 gater: Gater | None = None):
+                 gater: Gater | None = None,
+                 msg_rate: float = 500.0, msg_burst: int = 1000):
+        from ..ratelimit import RateLimiter
+
         super().__init__(name)
         self.gater = gater or Gater()
+        # per-peer ingress rate limit, ahead of the validate pool
+        # (reference: the stream-layer limiter tiers; gossipsub's
+        # per-peer throttling role): one chatty peer must not own the
+        # shared validation queue.  Generous defaults — an N-validator
+        # committee's worst honest burst is ~N msgs per phase + the
+        # sender retry tails
+        self._msg_limiter = RateLimiter(msg_rate, msg_burst)
+        self.dropped_rate_limited = 0
         self._peers: dict[object, str] = {}  # socket -> peer name
         self._peer_lock = threading.Lock()
         self._closing = False
@@ -321,6 +332,7 @@ class TCPHost(Host):
                 self._peer_addr.pop(sock, None)
                 live = {id(s) for s in self._peers}
             self._send_locks.pop(id(sock), None)
+            self._msg_limiter.drop(str(id(sock)))
             with self._score_lock:
                 self._scores.pop(id(sock), None)
             # an in-flight flood can setdefault a lock back after the
@@ -345,6 +357,12 @@ class TCPHost(Host):
         return bytes([len(t)]) + t + payload
 
     def _on_publish(self, body: bytes, src_sock, frm: str, ip: str):
+        # keyed on CONNECTION identity, like the scores: a spoofed
+        # HELLO name must not drain an honest peer's bucket
+        if not self._msg_limiter.allow(str(id(src_sock))):
+            with self._score_lock:
+                self.dropped_rate_limited += 1
+            return  # NOT marked seen: another (slower) peer may relay
         mid = keccak256(body)
         if self._seen.seen(mid):
             return

@@ -28,6 +28,12 @@ class RateLimiter:
             self._state[key] = (tokens - 1.0, now)
             return True
 
+    def drop(self, key: str):
+        """Forget a key's bucket (a disconnected peer's state must
+        not accumulate across churn)."""
+        with self._lock:
+            self._state.pop(key, None)
+
     def wait(self, key: str):
         """Block until a token is available, then consume it — the
         back-pressure shape (serve slowly, never drop)."""

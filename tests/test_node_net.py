@@ -648,3 +648,37 @@ def test_tcp_validation_pool_and_peer_scoring():
         assert good == [b"ok-1"]  # junk never delivered
     finally:
         h1.close(), h2.close()
+
+
+def test_tcp_per_peer_ingress_rate_limit():
+    """One chatty peer is throttled ahead of the validation pool; a
+    quiet peer on the same IP keeps flowing (buckets key on the
+    CONNECTION, so neither a shared address nor a spoofed HELLO name
+    pools or drains another peer's budget)."""
+    chatty = TCPHost("chatty")
+    quiet = TCPHost("chatty")  # same (spoofed) name, same 127.0.0.1
+    h2 = TCPHost("victim", msg_rate=5.0, msg_burst=10)
+    try:
+        chatty.connect(h2.port)
+        quiet.connect(h2.port)
+        assert h2.wait_for_peers(2)
+        assert chatty.wait_for_peers(1) and quiet.wait_for_peers(1)
+        got = []
+        h2.subscribe("t", lambda t, p, f: got.append(p))
+        for i in range(50):
+            chatty.publish("t", b"m%d" % i)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and h2.dropped_rate_limited == 0:
+            time.sleep(0.02)
+        assert h2.dropped_rate_limited > 0  # excess shed
+        time.sleep(0.3)
+        flood_got = len(got)
+        assert 0 < flood_got <= 12  # burst-bounded delivery, no flood
+        # the quiet peer's own bucket is untouched by the flood
+        quiet.publish("t", b"quiet-1")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and b"quiet-1" not in got:
+            time.sleep(0.02)
+        assert b"quiet-1" in got
+    finally:
+        chatty.close(), quiet.close(), h2.close()
