@@ -202,9 +202,15 @@ def _child():
     if not on_tpu:
         # XLA:CPU cannot build ANY pairing-shaped program inside the
         # budget on the 1-core fallback box (>20 min jit OR eager,
-        # measured 2026-07-29) — measure the HOST BIGINT twin instead
-        # so the round still records real numbers, clearly labeled.
-        extra["backend"] = "cpu-bigint-reference"
+        # measured 2026-07-29) — measure the HOST path instead so the
+        # round still records real numbers, clearly labeled: the native
+        # C++ library (native/bls381.cpp) when it loads, the bigint twin
+        # otherwise.
+        from harmony_tpu.ref import native as NB
+
+        extra["backend"] = (
+            "cpu-native-bls381" if NB.available() else "cpu-bigint-reference"
+        )
         return _child_cpu_bigint(extra, deadline)
 
     # ---- shared fixtures (small host-side setup) ----------------------
@@ -348,16 +354,21 @@ def _child():
 
 
 def _child_cpu_bigint(extra, deadline):
-    """Honest fallback numbers from the bigint reference twin: the
-    driver's TPU tunnel has been dead in both prior rounds; a labeled
-    host measurement beats a traceback and gives optimization work a
-    floor to compare against."""
+    """Honest fallback numbers from the host crypto path: the driver's
+    TPU tunnel has been dead in every prior round; a labeled host
+    measurement beats a traceback and gives optimization work a floor
+    to compare against.  Since round 5 the host path is the native C++
+    library (native/bls381.cpp) when it loads — the role herumi's mcl
+    plays under the reference — with the bigint twin as last resort."""
     import time as _t
 
     from harmony_tpu.ref import bls as RB
+    from harmony_tpu.ref import native as NB
     from harmony_tpu.ref import pairing as RP
     from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
     from harmony_tpu.ref.hash_to_curve import hash_to_g2
+
+    native = NB.available()
 
     msg = b"bench-agg-verify-block-payload!!"
     h_pt = hash_to_g2(msg)
@@ -367,7 +378,10 @@ def _child_cpu_bigint(extra, deadline):
     n_max = 1000
     sks = [RB.keygen(bytes([i % 251, i // 251])) for i in range(n_max)]
     pks = [RB.pubkey(sk) for sk in sks]
-    sigs = [g2.mul(h_pt, sk) for sk in sks]  # precomputed-h signing
+    # precomputed-h signing; twin g2.mul costs ~112 ms each, so the
+    # fixture must ride the native path when it is loaded
+    _g2mul = NB.g2_mul if native else g2.mul
+    sigs = [_g2mul(h_pt, sk) for sk in sks]
 
     for n_keys, label in ((250, "agg_verify_p50_ms_host"),
                           (1000, "agg_verify_p50_ms_host_1k")):
@@ -392,15 +406,40 @@ def _child_cpu_bigint(extra, deadline):
                 f"agg_verify_host_{n_keys}: {e!r:.300}"
             )
 
-    # primary: raw bigint pairing throughput
-    n = 6
-    pairs = [
-        (g1.mul(G1_GEN, 3 + i), g2.mul(G2_GEN, 5 + i)) for i in range(n)
-    ]
-    t0 = _t.perf_counter()
-    for p, q in pairs:
-        RP.pairing(p, q)
-    rate = n / (_t.perf_counter() - t0)
+    # primary: raw host pairing throughput (full pairing incl. final exp)
+    if native:
+        pairs = [
+            (NB.g1_mul(G1_GEN, 3 + i), NB.g2_mul(G2_GEN, 5 + i))
+            for i in range(16)
+        ]
+        for p, q in pairs[:4]:  # warm the library/page cache
+            NB.multi_pairing([(p, q)])
+        n = 0
+        t0 = _t.perf_counter()
+        while _t.perf_counter() - t0 < 3.0 and _t.monotonic() < deadline:
+            p, q = pairs[n % len(pairs)]
+            NB.multi_pairing([(p, q)])
+            n += 1
+        rate = n / (_t.perf_counter() - t0)
+        # the replay shape shares one final exponentiation across the
+        # product — record that Miller-loop-bound rate too
+        t0 = _t.perf_counter()
+        reps = 0
+        while _t.perf_counter() - t0 < 2.0 and _t.monotonic() < deadline:
+            NB.multi_pairing(pairs)
+            reps += 1
+        extra["pairing_product_pairs_per_sec"] = round(
+            reps * len(pairs) / (_t.perf_counter() - t0), 1
+        )
+    else:
+        n = 6
+        pairs = [
+            (g1.mul(G1_GEN, 3 + i), g2.mul(G2_GEN, 5 + i)) for i in range(n)
+        ]
+        t0 = _t.perf_counter()
+        for p, q in pairs:
+            RP.pairing(p, q)
+        rate = n / (_t.perf_counter() - t0)
     _emit(
         {
             "metric": PRIMARY,

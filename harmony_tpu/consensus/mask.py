@@ -119,10 +119,8 @@ class Mask:
         bigints (both bitwise-identical, tested).
         """
         if not device or len(self.publics) == 0:
-            acc = None
-            for i in self.index_enabled():
-                acc = RC.g1.add(acc, self.publics[i])
-            return acc
+            # native Jacobian sum when available, affine bigint otherwise
+            return RB.aggregate_pubkeys(self.get_signed_pubkeys())
         import jax.numpy as jnp
 
         from ..ops import curve as CV

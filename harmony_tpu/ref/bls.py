@@ -16,6 +16,7 @@ import hashlib
 import os
 
 from . import fields as F
+from . import native as NB
 from .curve import G1_GEN, g1, g2
 from .pairing import multi_pairing
 from .params import R_ORDER
@@ -39,6 +40,8 @@ def keygen(seed: bytes | None = None) -> int:
 
 
 def pubkey(sk: int):
+    if NB.available():
+        return NB.g1_mul(G1_GEN, sk % R_ORDER)
     return g1.mul(G1_GEN, sk % R_ORDER)
 
 
@@ -46,6 +49,8 @@ def sign(sk: int, msg_hash: bytes):
     """SignHash analog: sign a (typically 32-byte) message hash."""
     from .hash_to_curve import hash_to_g2
 
+    if NB.available():
+        return NB.g2_mul(hash_to_g2(msg_hash), sk % R_ORDER)
     return g2.mul(hash_to_g2(msg_hash), sk % R_ORDER)
 
 
@@ -60,12 +65,17 @@ def verify(pk, msg_hash: bytes, sig) -> bool:
     if pk is None or sig is None:
         return False
     h = hash_to_g2(msg_hash)
+    if NB.available():
+        return NB.pairing_check([(g1.neg(G1_GEN), sig), (pk, h)])
     gt = multi_pairing([(g1.neg(G1_GEN), sig), (pk, h)])
     return gt == F.FP12_ONE
 
 
 def aggregate_sigs(sigs):
     """Sign.Add analog: sum signatures in G2."""
+    sigs = list(sigs)
+    if NB.available():
+        return NB.g2_sum(sigs)
     acc = None
     for s in sigs:
         acc = g2.add(acc, s)
@@ -74,6 +84,9 @@ def aggregate_sigs(sigs):
 
 def aggregate_pubkeys(pks):
     """PublicKey.Add analog: sum public keys in G1 (mask aggregation)."""
+    pks = list(pks)
+    if NB.available():
+        return NB.g1_sum(pks)
     acc = None
     for p in pks:
         acc = g1.add(acc, p)
@@ -85,6 +98,8 @@ def verify_hashed(pk, h_point, sig) -> bool:
     once and verify many — the engine's batch replay path)."""
     if pk is None or sig is None:
         return False
+    if NB.available():
+        return NB.pairing_check([(g1.neg(G1_GEN), sig), (pk, h_point)])
     gt = multi_pairing([(g1.neg(G1_GEN), sig), (pk, h_point)])
     return gt == F.FP12_ONE
 

@@ -22,8 +22,9 @@ and only touches this module.
 import hashlib
 
 from . import fields as F
+from . import native as NB
 from .curve import clear_cofactor_g2, g2
-from .params import P
+from .params import H2, P
 
 _DST = b"HARMONY-TPU-BLS12381G2-TAI-SHA256-V1"
 
@@ -40,8 +41,14 @@ def map_to_twist(msg: bytes):
 
     Returns an E'(Fp2) point NOT yet in the r-torsion subgroup.
     """
+    native = NB.available()
     for ctr in range(256):
         x = (_hash_to_fp(msg, ctr, 0), _hash_to_fp(msg, ctr, 1))
+        if native:
+            pt = NB.g2_map_tai(x)  # same sqrt + canonical-y conventions
+            if pt is not None:
+                return pt
+            continue
         rhs = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g2.b)
         y = F.fp2_sqrt(rhs)
         if y is None:
@@ -56,7 +63,11 @@ def map_to_twist(msg: bytes):
 
 def hash_to_g2(msg: bytes):
     """Full hash-to-G2: map to the twist, then clear the cofactor."""
-    pt = clear_cofactor_g2(map_to_twist(msg))
+    tw = map_to_twist(msg)
+    if NB.available():
+        pt = NB.g2_mul(tw, H2)
+    else:
+        pt = clear_cofactor_g2(tw)
     if pt is None:  # astronomically unlikely (prob 1/r)
         raise ValueError("hash_to_g2 produced infinity")
     return pt

@@ -56,6 +56,7 @@ this framework are self-consistent under every carried convention
 """
 
 from . import fields as F
+from . import native as NB
 from .curve import g1, g2
 from .params import H2, P, R_ORDER
 
@@ -110,14 +111,17 @@ def g1_deserialize(data: bytes, check_subgroup: bool = True):
     x = int.from_bytes(data[:47] + bytes([data[47] & 0x7F]), "little")
     if x >= P:
         raise ValueError("herumi G1 x out of range")
-    y = F.fp_sqrt((x * x % P * x + g1.b) % P)
+    rhs = (x * x % P * x + g1.b) % P
+    y = NB.fp_sqrt(rhs) if NB.available() else F.fp_sqrt(rhs)
     if y is None:
         raise ValueError("herumi G1 x not on curve")
     if bool(y & 1) != odd:
         y = (-y) % P
     pt = (x, y)
     # rogue-point defense, as in serialize.py: mcl's verifyOrder
-    if check_subgroup and g1.mul(pt, R_ORDER) is not None:
+    from .serialize import _g1_subgroup_ok
+
+    if check_subgroup and not _g1_subgroup_ok(pt):
         raise ValueError("herumi G1 point not in the r-torsion subgroup")
     return pt
 
@@ -153,13 +157,15 @@ def g2_deserialize(data: bytes, check_subgroup: bool = True):
         raise ValueError("herumi G2 x out of range")
     x = (a, b)
     rhs = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g2.b)
-    y = F.fp2_sqrt(rhs)
+    y = NB.fp2_sqrt(rhs) if NB.available() else F.fp2_sqrt(rhs)
     if y is None:
         raise ValueError("herumi G2 x not on curve")
     if _fp2_is_odd(y) != odd:
         y = F.fp2_neg(y)
     pt = (x, y)
-    if check_subgroup and g2.mul(pt, R_ORDER) is not None:
+    from .serialize import _g2_subgroup_ok
+
+    if check_subgroup and not _g2_subgroup_ok(pt):
         raise ValueError("herumi G2 point not in the r-torsion subgroup")
     return pt
 
@@ -241,6 +247,8 @@ def _choose_root(y):
 
 def _clear_cofactor(pt):
     h = H2 if MAP_CONVENTION["cofactor"] == "h2" else H2_EFF
+    if NB.available():
+        return NB.g2_mul(pt, h)
     return g2.mul(pt, h)
 
 
@@ -259,9 +267,10 @@ def map_to_g2_herumi(msg_hash: bytes):
     t &= (1 << 380) - 1
     t %= P
     x = (t, 0)
+    native = NB.available()
     for _ in range(512):
         rhs = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g2.b)
-        y = F.fp2_sqrt(rhs)
+        y = NB.fp2_sqrt(rhs) if native else F.fp2_sqrt(rhs)
         if y is not None:
             pt = _clear_cofactor((x, _choose_root(y)))
             if pt is not None:
@@ -276,10 +285,14 @@ def map_to_g2_herumi(msg_hash: bytes):
 
 
 def pubkey(sk: int):
+    if NB.available():
+        return NB.g1_mul(HERUMI_G1, sk % R_ORDER)
     return g1.mul(HERUMI_G1, sk % R_ORDER)
 
 
 def sign_hash(sk: int, msg_hash: bytes):
+    if NB.available():
+        return NB.g2_mul(map_to_g2_herumi(msg_hash), sk % R_ORDER)
     return g2.mul(map_to_g2_herumi(msg_hash), sk % R_ORDER)
 
 
@@ -291,5 +304,7 @@ def verify_hash(pk, msg_hash: bytes, sig) -> bool:
     if pk is None or sig is None:
         return False
     h = map_to_g2_herumi(msg_hash)
+    if NB.available():
+        return NB.pairing_check([(g1.neg(HERUMI_G1), sig), (pk, h)])
     gt = RP.multi_pairing([(g1.neg(HERUMI_G1), sig), (pk, h)])
     return gt == FP12_ONE
