@@ -50,6 +50,9 @@ class Header:
     parent_hash: bytes = bytes(32)
     root: bytes = bytes(32)  # state root
     tx_root: bytes = bytes(32)  # body commitment (ordered tx hashes)
+    # execution receipts commitment (reference: header ReceiptHash) —
+    # what the fast-sync receipts stage verifies downloads against
+    receipt_root: bytes = bytes(32)
     # outgoing cross-shard receipt commitment: keccak over the sorted
     # (destination shard, group root) pairs (reference:
     # block/header OutgoingReceiptHash, core/types/cx_receipt.go
@@ -77,6 +80,7 @@ class Header:
             self.parent_hash,
             self.root,
             self.tx_root,
+            self.receipt_root,
         ]
         if self.version != "v0":
             items.append(self.out_cx_root)

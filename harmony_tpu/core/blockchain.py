@@ -246,6 +246,10 @@ class Blockchain:
         groups = types.group_cx_by_shard(result.outgoing_cx)
         if types.out_cx_root(groups) != block.header.out_cx_root:
             raise ChainError("outgoing receipt root mismatch")
+        if types.receipts_root(
+            result.receipts + result.staking_receipts
+        ) != block.header.receipt_root:
+            raise ChainError("receipt root mismatch after execution")
         elected = self.post_process(
             state, block.block_num, epoch,
             block.header.last_commit_bitmap or None,

@@ -43,6 +43,7 @@ _HEADER_FIELDS = (
     ("version", "str"), ("shard_id", "int"), ("block_num", "int"),
     ("epoch", "int"), ("view_id", "int"), ("timestamp", "int"),
     ("parent_hash", "bytes"), ("root", "bytes"), ("tx_root", "bytes"),
+    ("receipt_root", "bytes"),
     ("out_cx_root", "bytes"), ("last_commit_sig", "bytes"),
     ("last_commit_bitmap", "bytes"), ("extra", "bytes"),
     ("vrf", "bytes"), ("vdf", "bytes"), ("shard_state", "bytes"),

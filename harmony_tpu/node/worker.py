@@ -15,7 +15,9 @@ from __future__ import annotations
 from ..chain.header import Header
 from ..core import rawdb
 from ..core.state_processor import ExecutionError
-from ..core.types import Block, group_cx_by_shard, out_cx_root
+from ..core.types import (
+    Block, group_cx_by_shard, out_cx_root, receipts_root,
+)
 
 DEFAULT_BLOCK_TX_CAP = 1024
 
@@ -47,6 +49,7 @@ class Worker:
         epoch = self.chain.epoch_of(num)
 
         plain, staking, order = [], [], []
+        plain_receipts, staking_receipts = [], []
         outgoing = []
         state = self.chain.state().copy()
         gas_used = 0
@@ -67,11 +70,13 @@ class Worker:
                             )
                         )
                         staking.append(tx)
+                        staking_receipts.append(receipt)
                     else:
                         receipt, cx = self.chain.processor.apply_transaction(
                             state, tx, num, gas_used
                         )
                         plain.append(tx)
+                        plain_receipts.append(receipt)
                         if cx is not None:
                             outgoing.append(cx)
                     order.append(1 if is_staking else 0)
@@ -107,6 +112,7 @@ class Worker:
             parent_hash=parent.hash(),
             root=self.chain.config.state_root(state, epoch),
             tx_root=block.tx_root(self.chain.config.chain_id),
+            receipt_root=receipts_root(plain_receipts + staking_receipts),
             out_cx_root=out_cx_root(group_cx_by_shard(outgoing)),
             timestamp=timestamp,
             last_commit_sig=last_sig,

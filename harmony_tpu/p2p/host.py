@@ -221,6 +221,7 @@ class TCPHost(Host):
         self.dropped_overflow = 0  # messages shed at the full queue
         self._score_lock = threading.Lock()
         self._scores: dict[int, tuple[float, float]] = {}  # sockid->(s,at)
+        self._ip_strikes: dict[str, int] = {}  # floor hits per address
         for i in range(self.VALIDATE_WORKERS):
             threading.Thread(
                 target=self._validate_worker, daemon=True,
@@ -411,12 +412,19 @@ class TCPHost(Host):
                     "gossip handler raised", me=self.name, topic=topic,
                 )
 
+    # distinct connections from one IP that must hit the score floor
+    # before the IP itself is gater-banned (ADVICE r4: a single bad
+    # connection must not collaterally ban every honest peer behind a
+    # shared address — the localnet's 127.0.0.1, NAT'd topologies)
+    IP_BAN_STRIKES = 3
+
     def _punish(self, ip: str, sock):
         """Score the CONNECTION down for a rejected message; at the
-        floor, drop it and ban the IP through the gater (gossipsub
-        scoring's role, on the flood topology).  Scores key on the
-        connection so peers sharing an address don't pool penalties;
-        the ban itself is per-IP — that's the gater's model."""
+        floor, drop THAT connection (the per-peer ban — gossipsub
+        scoring's role, on the flood topology).  The IP-level gater ban
+        is reserved for repeated offenses across distinct connections,
+        and never applied to loopback, so shared-IP peers aren't
+        collaterally refused."""
         now = time.monotonic()
         with self._score_lock:
             score, at = self._scores.get(id(sock), (0.0, now))
@@ -425,13 +433,22 @@ class TCPHost(Host):
             ) - 1.0
             self._scores[id(sock)] = (score, now)
         if score <= self.SCORE_FLOOR:
-            _log.warn(
-                "peer banned for spam", me=self.name, ip=ip,
-                score=round(score, 1),
-            )
-            self.gater.ban(ip)
             with self._score_lock:
                 self._scores.pop(id(sock), None)
+                strikes = self._ip_strikes.get(ip, 0) + 1
+                self._ip_strikes[ip] = strikes
+            loopback = ip.startswith("127.") or ip in ("::1", "localhost")
+            if strikes >= self.IP_BAN_STRIKES and not loopback:
+                _log.warn(
+                    "ip banned for repeated spam", me=self.name, ip=ip,
+                    strikes=strikes,
+                )
+                self.gater.ban(ip)
+            else:
+                _log.warn(
+                    "peer connection dropped for spam", me=self.name,
+                    ip=ip, score=round(score, 1), strikes=strikes,
+                )
             try:
                 sock.close()  # reader thread unwinds and releases
             except OSError:

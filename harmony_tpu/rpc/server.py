@@ -656,6 +656,15 @@ class RPCServer:
             raise ValueError(f"unknown tracer {which!r}")
         evm = EVM(state, env, origin=sender, gas_price=tx.gas_price,
                   tracer=tracer)
+        # mirror the processor's EIP-2929/2930 warm-up (ADVICE r4:
+        # without it traces charge cold 2600/2100 where the canonical
+        # run paid warm 100, and near-limit txs trace as out-of-gas)
+        if tx.to is not None:
+            evm.warm_addrs.add(tx.to)
+        for al_addr, al_slots in tx.access_list:
+            evm.warm_addrs.add(al_addr)
+            for slot in al_slots:
+                evm.warm_slots.add((al_addr, slot))
         if which == "prestateTracer":
             # capture the sender BEFORE the replay's nonce bump —
             # enter() only fires inside the call

@@ -108,16 +108,29 @@ class Downloader:
         from ..core.state import StateDB, _decode_account
 
         accounts = {}
+        # generous sanity bound on total pages: a state bigger than
+        # this is not something fast sync should swallow silently
+        max_pages = int(1e6)
         for c in self.clients:
             try:
                 start = b""
-                while True:
+                for _ in range(max_pages):
                     page = c.get_account_range(num, start)
-                    for addr, blob in page:
-                        accounts[addr] = _decode_account(blob)
                     if not page:
                         break
+                    # progress guard (ADVICE r4): a peer repeating or
+                    # rewinding pages would make `start` a fixed point
+                    # and spin this loop forever — treat it as a bad
+                    # peer and rotate
+                    if page[-1][0] <= start:
+                        raise ConnectionError(
+                            "non-advancing account-range page"
+                        )
+                    for addr, blob in page:
+                        accounts[addr] = _decode_account(blob)
                     start = page[-1][0]
+                else:
+                    raise ConnectionError("account-range page bound hit")
                 return StateDB(accounts)
             except (ConnectionError, OSError):
                 accounts.clear()
@@ -176,16 +189,35 @@ class Downloader:
             return res
         res.inserted = last_inserted - head
         # stage: receipts — recent tail only (older blocks stay
-        # header-only, as after a snap sync)
+        # header-only, as after a snap sync).  Every downloaded list is
+        # verified against the sealed header's receipt_root BEFORE
+        # persisting (ADVICE r4: an unverified receipts stage lets a
+        # sync peer forge statuses/logs/contract addresses that
+        # eth_getTransactionReceipt would then serve as truth).
+        from ..core.types import receipts_root as _rroot
+
         lo = max(head + 1, last_inserted - receipts_tail + 1)
         for c in self.clients:
             try:
                 per_block = c.get_receipts(lo, last_inserted - lo + 1)
             except (ConnectionError, OSError):
                 continue
+            verified = []
             for i, receipts in enumerate(per_block):
-                if receipts:
-                    self.chain.write_synced_receipts(lo + i, receipts)
+                if not receipts:
+                    continue
+                hdr = self.chain.header_by_number(lo + i)
+                if hdr is None or _rroot(receipts) != hdr.receipt_root:
+                    res.errors.append(
+                        f"receipts commitment mismatch at {lo + i}"
+                    )
+                    verified = None
+                    break
+                verified.append((lo + i, receipts))
+            if verified is None:
+                continue  # forged/buggy receipts: rotate peer
+            for n, receipts in verified:
+                self.chain.write_synced_receipts(n, receipts)
             break
         _log.info(
             "fast sync done", head=self.chain.head_number,

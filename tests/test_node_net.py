@@ -164,6 +164,66 @@ def test_fast_sync_joins_head_without_replay():
         srv.close()
 
 
+def test_fast_sync_rejects_forged_receipts():
+    """ADVICE r4: the receipts stage verifies every downloaded list
+    against the sealed header's receipt_root — a peer serving forged
+    statuses/logs is rotated away instead of poisoning
+    eth_getTransactionReceipt."""
+    serving, genesis = _chain_with_blocks(3)
+    srv = SyncServer(serving)
+
+    class ForgingClient(SyncClient):
+        def get_receipts(self, start, count):
+            per_block = super().get_receipts(start, count)
+            for receipts in per_block:
+                for r in receipts:
+                    r.status = 0  # flip success -> failure
+            return per_block
+
+    try:
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(fresh, [ForgingClient(srv.port)], batch=2,
+                        verify_seals=False)
+        res = dl.fast_sync(receipts_tail=2)
+        # chain still syncs; the forged receipts were refused
+        assert fresh.head_number == 3
+        assert any("receipts commitment mismatch" in e for e in res.errors)
+        from harmony_tpu.core import rawdb
+
+        assert not rawdb.read_receipts(fresh.db, 3)
+        # an honest second peer heals the tail
+        dl2 = Downloader(fresh, [SyncClient(srv.port)], batch=2,
+                         verify_seals=False)
+        dl2.fast_sync(receipts_tail=2)
+    finally:
+        srv.close()
+
+
+def test_fast_sync_rotates_on_non_advancing_account_pages():
+    """ADVICE r4: a peer repeating account-range pages must not wedge
+    the states stage in an infinite loop — the downloader breaks and
+    rotates to the next peer."""
+    serving, genesis = _chain_with_blocks(3)
+    srv = SyncServer(serving)
+
+    class LoopingClient(SyncClient):
+        def get_account_range(self, num, start):
+            page = super().get_account_range(num, b"")
+            return page  # always the FIRST page: start never advances
+
+    try:
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(
+            fresh, [LoopingClient(srv.port), SyncClient(srv.port)],
+            batch=2, verify_seals=False,
+        )
+        res = dl.fast_sync(receipts_tail=1)
+        assert res.inserted == 3 and not res.errors  # healed via peer 2
+        assert fresh.state().root() == serving.state().root()
+    finally:
+        srv.close()
+
+
 def test_fast_sync_harvests_committees_from_sealed_headers():
     """The fast-sync trust chain across an election (VERDICT r3 #6 +
     review hardening): the next epoch's committee is read from the
@@ -605,8 +665,9 @@ def test_operator_distinct_leader_rotation():
 def test_tcp_validation_pool_and_peer_scoring():
     """reference: p2p/host.go's bounded validate pool + gossipsub
     scoring's role: spam that fails validation drives the sender's
-    score to the floor, banning its IP through the gater; the reader
-    thread never blocks on a slow validator."""
+    score to the floor, dropping that CONNECTION; the shared loopback
+    address stays un-banned (ADVICE r4: no collateral IP bans), and
+    the reader thread never blocks on a slow validator."""
     h1 = TCPHost("spammer")
     h2 = TCPHost("victim")
     h2.SCORE_FLOOR = -5.0  # fail fast for the test
@@ -643,9 +704,23 @@ def test_tcp_validation_pool_and_peer_scoring():
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline and h2.peer_count():
             time.sleep(0.05)
-        assert h2.peer_count() == 0  # dropped
-        assert not h2.gater.allow("127.0.0.1")  # and banned
+        assert h2.peer_count() == 0  # the offending connection dropped
+        # loopback is NEVER IP-banned: honest peers sharing the address
+        # must stay connectable (the ban was per-connection)
+        assert h2.gater.allow("127.0.0.1")
         assert good == [b"ok-1"]  # junk never delivered
+        # repeated floor hits from distinct NON-loopback connections DO
+        # escalate to the gater (driven directly: loopback sockets are
+        # all this test topology has)
+        class _Sock:
+            def close(self):
+                pass
+
+        for _ in range(h2.IP_BAN_STRIKES):
+            sock = _Sock()
+            for _ in range(10):
+                h2._punish("10.9.8.7", sock)
+        assert not h2.gater.allow("10.9.8.7")
     finally:
         h1.close(), h2.close()
 
