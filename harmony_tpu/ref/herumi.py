@@ -199,7 +199,17 @@ def g2_deserialize(data: bytes, check_subgroup: bool = True):
 #           r-torsion, is NOT the same point as h2*P, and h_eff != 0
 #           mod r, so the two methods are genuinely distinct
 #           conventions that a signature vector will disambiguate.
-MAP_CONVENTION = {"root": "even", "cofactor": "h2"}
+# Default = the mcl-source best guess (VERDICT r4 #6): "algorithmic"
+# root because mcl's Fp2 sqrt is the raw complex-method composition of
+# the principal Fp power with no canonicalization pass (the module
+# docstring's analytic argument), and plain-"h2" cofactor because mcl's
+# pre-IETF hashAndMapToG2 multiplies by the precomputed cofactor
+# constant rather than the psi-based effective-cofactor route it
+# reserves for the IETF ciphersuites.  RESIDUAL RISK (PARITY.md): both
+# choices are reasoned, not vector-pinned — the moment ANY herumi
+# signature vector exists, run tools/pin_herumi.py and it emits the
+# definitive pin (env override, no code change).
+MAP_CONVENTION = {"root": "algorithmic", "cofactor": "h2"}
 
 # RFC 9380 §8.8.2 effective cofactor for BLS12-381 G2 (Budroni-Pintore
 # psi-based clearing as a single scalar).
