@@ -288,7 +288,8 @@ def build_node(cfg: dict):
         if cfg.get("verify_seals", True) else None
     )
     chain = Blockchain(db, genesis, engine=engine,
-                       blocks_per_epoch=cfg["blocks_per_epoch"])
+                       blocks_per_epoch=cfg["blocks_per_epoch"],
+                       state_retention=cfg.get("state_retention"))
     chain_cell.append(chain)
     if cfg["shard_id"] != 0:
         # non-beacon shards follow beacon committee rotation through
@@ -517,6 +518,21 @@ def main(argv=None):
     p.add_argument("--revert-to", type=int, dest="revert_to",
                    help="roll the chain back to this block and exit "
                         "(the reference's revert tooling)")
+    p.add_argument("--state-retention", type=int, dest="state_retention",
+                   help="keep only the last N block states (pruned "
+                        "node; default: archive, keep all)")
+    p.add_argument("--prune-states", type=int, dest="prune_states",
+                   help="offline: delete state blobs older than "
+                        "head-N, then exit (blockchain_pruner role)")
+    p.add_argument("--snapshot-export", dest="snapshot_export",
+                   help="offline: write the head state snapshot to "
+                        "this file, then exit")
+    p.add_argument("--snapshot-import", dest="snapshot_import",
+                   help="offline: install a snapshot file, then exit")
+    p.add_argument("--snapshot-trust", action="store_true",
+                   dest="snapshot_trust",
+                   help="allow --snapshot-import into a chain that "
+                        "does not yet have the snapshot's header")
     args = p.parse_args(argv)
     cfg = load_config(args.config, vars(args))
     init_logging(cfg.get("log_level"), cfg.get("log_path"))
@@ -531,6 +547,29 @@ def main(argv=None):
             f"reverted {n} block(s); head is now {chain.head_number}",
             flush=True,
         )
+        return 0
+
+    if (cfg.get("prune_states") is not None
+            or cfg.get("snapshot_export") or cfg.get("snapshot_import")):
+        # offline state maintenance (core/snapshot.py)
+        from .core import snapshot as SN
+
+        chain = open_chain_for_maintenance(cfg)
+        if cfg.get("snapshot_import"):
+            num = SN.import_snapshot(
+                chain, cfg["snapshot_import"],
+                trust=bool(cfg.get("snapshot_trust")),
+            )
+            print(f"snapshot installed at block {num}", flush=True)
+        if cfg.get("prune_states") is not None:
+            n = SN.prune_states(chain, int(cfg["prune_states"]))
+            print(f"pruned {n} historical state(s)", flush=True)
+        if cfg.get("snapshot_export"):
+            num = SN.export_snapshot(chain, cfg["snapshot_export"])
+            print(
+                f"snapshot of block {num} -> {cfg['snapshot_export']}",
+                flush=True,
+            )
         return 0
 
     # clock sanity before consensus (reference: common/ntp at startup):

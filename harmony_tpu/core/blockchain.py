@@ -83,12 +83,15 @@ class ChainError(ValueError):
 
 class Blockchain:
     def __init__(self, db, genesis: Genesis, engine=None,
-                 blocks_per_epoch: int = 32768, finalizer=None):
+                 blocks_per_epoch: int = 32768, finalizer=None,
+                 state_retention: int | None = None):
         """engine: chain.engine.Engine or None (no seal checks — tests
         and block production before wiring consensus).  finalizer:
         chain.finalize.Finalizer or None (no rewards/election — the
-        pre-staking chain shape)."""
+        pre-staking chain shape).  state_retention: keep only the last
+        N block states (None = archive node, every state kept)."""
         self.db = db
+        self.state_retention = state_retention
         self.genesis = genesis
         self.config = genesis.config
         self.shard_id = genesis.shard_id
@@ -542,6 +545,14 @@ class Blockchain:
             )
             if proof is not None:
                 rawdb.write_commit_sig(self.db, block.block_num, proof)
+            if self.state_retention:
+                # incremental prune: the state falling out of the
+                # retention window (O(1) per insert; core/snapshot.py)
+                from .snapshot import prune_state_at
+
+                prune_state_at(
+                    self, block.block_num - self.state_retention
+                )
             by_shard: dict[int, list] = {}
             for cx in result.outgoing_cx:
                 by_shard.setdefault(cx.to_shard, []).append(cx)

@@ -282,6 +282,11 @@ def read_state(db, root: bytes) -> bytes | None:
     return db.get(_STATE + root)
 
 
+def delete_state(db, root: bytes) -> None:
+    """Drop a historical state blob (core/snapshot.py pruning)."""
+    db.delete(_STATE + root)
+
+
 def write_receipts(db, num: int, receipts: list):
     from .types import Receipt  # noqa: F401 — encoded via Receipt.encode
 

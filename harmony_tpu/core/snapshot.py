@@ -1,0 +1,182 @@
+"""State snapshots + historical-state pruning.
+
+The chain persists one full serialized StateDB per block (rawdb
+``S || root``) — simple and crash-safe, but unbounded: a long-running
+node's store grows with every block.  This module is the framework's
+analog of the reference's snapshot/pruning pair (reference:
+core/state/snapshot/ flat snapshot tree, core/blockchain_pruner.go):
+
+* **Pruning** deletes historical state blobs outside a retention
+  window, incrementally on insert (O(1) per block) or in bulk.  Headers,
+  bodies, receipts and commit proofs are kept — a pruned node is a full
+  header-chain node with recent-state depth, exactly the shape a fast
+  (snap) sync produces.
+* **Snapshots** export one sealed block's state (header + commit proof +
+  accounts) to a single file, and import it back with the SAME binding
+  check fast sync uses (config.state_root vs the sealed header root), so
+  a snapshot can restore a pruned node or bootstrap a fresh one.
+
+Root sharing: consecutive blocks with identical state (no txs, no
+rewards) reuse one ``S || root`` entry; the pruner defers deletion until
+the NEXT block's root differs, so a retained block never loses its
+state to the pruning of an older twin.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import rawdb
+from .state import StateDB
+
+_MAGIC = b"HTSNAP1\n"
+
+
+class SnapshotError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+def prune_state_at(chain, num: int) -> bool:
+    """Delete block ``num``'s state blob if it is safe: never the
+    genesis state, and never a root shared with the NEXT block (the
+    retained chain still references it).  Returns True if deleted."""
+    if num <= 0:
+        return False
+    header = rawdb.read_header(chain.db, num)
+    if header is None:
+        return False
+    nxt = rawdb.read_header(chain.db, num + 1)
+    if nxt is not None and nxt.root == header.root:
+        return False  # shared root: defer to the next block's pruning
+    if rawdb.read_state(chain.db, header.root) is None:
+        return False
+    rawdb.delete_state(chain.db, header.root)
+    return True
+
+
+def prune_states(chain, retain: int) -> int:
+    """Bulk prune: drop every state blob older than ``head - retain``
+    (reference: core/blockchain_pruner.go's offline prune).  Returns
+    how many blobs were deleted."""
+    if retain < 1:
+        raise SnapshotError("retention must be >= 1")
+    deleted = 0
+    for num in range(1, chain.head_number - retain + 1):
+        if prune_state_at(chain, num):
+            deleted += 1
+    return deleted
+
+
+# ---------------------------------------------------------------------------
+# snapshot files
+# ---------------------------------------------------------------------------
+
+def _enc_blob(b: bytes) -> bytes:
+    return len(b).to_bytes(8, "big") + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.o = 0
+
+    def blob(self) -> bytes:
+        n = int.from_bytes(self.d[self.o:self.o + 8], "big")
+        self.o += 8
+        out = self.d[self.o:self.o + n]
+        if len(out) != n:
+            raise SnapshotError("truncated snapshot")
+        self.o += n
+        return out
+
+
+def export_snapshot(chain, path: str, num: int | None = None) -> int:
+    """Write block ``num``'s (default: head) sealed state to ``path``.
+
+    Layout: magic || header || commit-proof || state-accounts.  The
+    commit proof ([96B agg sig || bitmap], empty when the store has
+    none, e.g. genesis) lets the importer's operator audit the seal.
+    """
+    num = chain.head_number if num is None else num
+    header = rawdb.read_header(chain.db, num)
+    if header is None:
+        raise SnapshotError(f"no header {num}")
+    blob = rawdb.read_state(chain.db, header.root)
+    if blob is None:
+        raise SnapshotError(
+            f"no state for block {num} (pruned? export a newer block)"
+        )
+    proof = rawdb.read_commit_sig(chain.db, num) or b""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(_enc_blob(rawdb.encode_header(header)))
+        f.write(_enc_blob(proof))
+        f.write(_enc_blob(blob))
+    os.replace(tmp, path)
+    return num
+
+
+def import_snapshot(chain, path: str, trust: bool = False) -> int:
+    """Install a snapshot file into ``chain``; returns its block number.
+
+    Binding: the accounts must hash to the snapshot header's sealed
+    state root (same check as fast sync's adopt_state).  The header
+    itself is trusted EITHER because the chain already has the same
+    header at that height (restore-after-prune / resync case) OR
+    because the operator passed ``trust=True`` (bootstrapping a fresh
+    node from an operator-asserted snapshot, the way a trusted snap
+    init works).
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(_MAGIC):
+        raise SnapshotError("not a snapshot file")
+    r = _Reader(data[len(_MAGIC):])
+    header = rawdb.decode_header(r.blob())
+    proof = r.blob()
+    state_blob = r.blob()
+    num = header.block_num
+
+    local = rawdb.read_header(chain.db, num)
+    if local is not None:
+        if local.hash() != header.hash():
+            raise SnapshotError(
+                f"snapshot header {num} does not match the local chain"
+            )
+    elif not trust:
+        raise SnapshotError(
+            f"chain has no header {num}: import with trust=True only if "
+            "the snapshot source is operator-trusted"
+        )
+
+    state = StateDB.deserialize(state_blob)
+    if chain.config.state_root(state, header.epoch) != header.root:
+        raise SnapshotError(
+            "snapshot accounts do not match the sealed state root"
+        )
+
+    with chain._insert_lock:
+        if local is None:
+            chain.db.put(
+                rawdb._num_key(rawdb._HEADER, num),
+                rawdb.encode_header(header),
+            )
+            chain.db.put(rawdb._num_key(rawdb._CANON, num), header.hash())
+            chain.db.put(
+                rawdb._NUM_BY_HASH + header.hash(),
+                num.to_bytes(8, "little"),
+            )
+        if proof:
+            rawdb.write_commit_sig(chain.db, num, proof)
+        rawdb.write_state(chain.db, header.root, state_blob)
+        if num >= chain.head_number:
+            rawdb.write_head_number(chain.db, num)
+            chain._head_num = num
+            chain._state = state
+            chain._committee_cache.clear()
+    return num
