@@ -21,6 +21,14 @@ class Instance:
     slots_per_shard: int
     harmony_nodes_per_shard: int
     harmony_vote_percent: Dec
+    # genesis-account table names (config/genesis_accounts.py) feeding
+    # the pre-staking committee assembly (reference: Instance
+    # hmyAccounts/fnAccounts); None = dev chain, keys generated
+    hmy_accounts_table: str | None = None
+    fn_accounts_table: str | None = None
+    # HIP-16 per-validator slot limit as a fraction of external slots
+    # (reference: Instance.SlotsLimit, 0 = unlimited)
+    slots_limit_fraction: float = 0.0
 
     def external_slots_per_shard(self) -> int:
         return self.slots_per_shard - self.harmony_nodes_per_shard
@@ -30,6 +38,12 @@ class Instance:
 
     def total_slots(self) -> int:
         return self.num_shards * self.slots_per_shard
+
+    def slots_limit(self) -> int:
+        """HIP-16 absolute cap per validator (reference:
+        shardingconfig SlotsLimit = fraction * external slots)."""
+        return int(self.slots_limit_fraction
+                   * self.external_slots_per_shard())
 
 
 class Schedule:
@@ -54,19 +68,62 @@ class Schedule:
         return chosen
 
 
-# A mainnet-shaped schedule (the reference's V3->V5 trajectory:
-# 4 shards x 250 slots shrinking to 2 x 200 with the Harmony vote share
-# stepping 0.49 -> 0.01 — reference: internal/configs/sharding/
-# mainnet.go:364-389).  Epoch thresholds here are representative; real
-# deployments supply their own table.
-MAINNET_LIKE = Schedule(
+def _m(shards, slots, hmy, pct, fn_table, hmy_table="HarmonyAccounts",
+       slots_limit=0.0):
+    return Instance(
+        shards, slots, hmy, Dec.from_str(pct),
+        hmy_accounts_table=hmy_table, fn_accounts_table=fn_table,
+        slots_limit_fraction=slots_limit,
+    )
+
+
+# THE mainnet schedule, every era transcribed (reference:
+# internal/configs/sharding/mainnet.go — mainnetV0..mainnetV5 instance
+# data :238-372, epoch dispatch :73-137; era thresholds :22-35 plus the
+# TwoSeconds/SixtyPercent/HIP6And8/SlotsLimited/FeeCollect/HIP30/HIP32
+# gates from internal/params/config.go's MainnetChainConfig).
+MAINNET = Schedule(
     [
-        (0, Instance(4, 250, 170, Dec.from_str("0.68"))),
-        (100, Instance(4, 250, 130, Dec.from_str("0.49"))),
-        (1000, Instance(2, 200, 50, Dec.from_str("0.06"))),
-        (1500, Instance(2, 200, 50, Dec.from_str("0.01"))),
+        (0, _m(4, 150, 112, "1.0", "FoundationalNodeAccounts")),
+        (1, _m(4, 152, 112, "1.0", "FoundationalNodeAccountsV0_1")),
+        (5, _m(4, 200, 148, "1.0", "FoundationalNodeAccountsV0_2")),
+        (8, _m(4, 210, 148, "1.0", "FoundationalNodeAccountsV0_3")),
+        (10, _m(4, 216, 148, "1.0", "FoundationalNodeAccountsV0_4")),
+        (12, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1")),
+        (19, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1_1")),
+        (25, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1_2")),
+        (36, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1_3")),
+        (46, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1_4")),
+        (54, _m(4, 250, 170, "1.0", "FoundationalNodeAccountsV1_5")),
+        (185, _m(4, 250, 170, "0.68", "FoundationalNodeAccountsV1_5")),
+        (208, _m(4, 250, 130, "0.68", "FoundationalNodeAccountsV1_5")),
+        (231, _m(4, 250, 90, "0.68", "FoundationalNodeAccountsV1_5")),
+        # 366 = TwoSecondsEpoch (mainnetV3: same shape as V2_2)
+        (366, _m(4, 250, 90, "0.68", "FoundationalNodeAccountsV1_5")),
+        # 530 = SixtyPercentEpoch (mainnetV3_1)
+        (530, _m(4, 250, 50, "0.60", "FoundationalNodeAccountsV1_5")),
+        # 725 = HIP6And8Epoch (mainnetV3_2)
+        (725, _m(4, 250, 25, "0.49", "FoundationalNodeAccountsV1_5")),
+        # 999 = SlotsLimitedEpoch (mainnetV3_3: HIP-16 cap 0.06)
+        (999, _m(4, 250, 25, "0.49", "FoundationalNodeAccountsV1_5",
+                 slots_limit=0.06)),
+        # 1535 = FeeCollectEpoch (mainnetV3_4: fee collectors added,
+        # committee shape unchanged)
+        (1535, _m(4, 250, 25, "0.49", "FoundationalNodeAccountsV1_5",
+                  slots_limit=0.06)),
+        # 1673 = HIP30Epoch (mainnetV4: 2 shards, post-HIP30 accounts)
+        (1673, _m(2, 200, 20, "0.49", "FoundationalNodeAccountsV1_5",
+                  hmy_table="HarmonyAccountsPostHIP30",
+                  slots_limit=0.06)),
+        # 2152 = HIP32Epoch (mainnetV5: internal share 0.01)
+        (2152, _m(2, 200, 2, "0.01", "FoundationalNodeAccountsV1_5",
+                  hmy_table="HarmonyAccountsPostHIP30",
+                  slots_limit=0.06)),
     ]
 )
+
+# Back-compat alias (pre-round-5 name; same trajectory, now exact)
+MAINNET_LIKE = MAINNET
 
 LOCALNET = Schedule(
     [(0, Instance(2, 10, 5, Dec.from_str("0.68")))]

@@ -47,6 +47,35 @@ class Genesis:
         return Block(header)
 
 
+def mainnet_genesis(shard_id: int = 0) -> Genesis:
+    """The mainnet-shaped genesis: the real epoch-gate table
+    (config.chain.mainnet_config), the real era-0 committee assembled
+    from the reference's foundational account tables with the
+    round-robin shard distribution (reference: internal/genesis/
+    foundational.go + harmony.go via shard/committee/assignment.go
+    preStakingEnabledCommittee), and the herumi-wire BLS pubkeys.
+
+    The account ALLOCATION is left empty: the reference's initial
+    token distribution lives in a one-off genesis contract deploy
+    (core/genesis.go GenesisSpec) that predates open-sourcing; nodes
+    joining mainnet acquire balances through sync, never genesis
+    replay.
+    """
+    from ..config.chain import mainnet_config
+    from ..config.genesis_accounts import committee_slots
+    from ..config.sharding import MAINNET
+
+    inst = MAINNET.instance_for_epoch(0)
+    slots = committee_slots(inst, shard_id)
+    return Genesis(
+        config=mainnet_config(),
+        shard_id=shard_id,
+        alloc={},
+        committee=[bls for _, bls, _ in slots],
+        extra=b"harmony-mainnet-genesis",
+    )
+
+
 def dev_genesis(n_accounts: int = 4, n_keys: int = 4,
                 shard_id: int = 0) -> tuple[Genesis, list, list]:
     """A deterministic localnet genesis: funded ECDSA accounts + a BLS

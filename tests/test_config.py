@@ -21,15 +21,15 @@ def test_epoch_gates():
 
 
 def test_schedule_lookup():
-    s = MAINNET_LIKE
+    s = MAINNET_LIKE  # alias of the exact MAINNET schedule since r5
     assert s.instance_for_epoch(0).num_shards == 4
-    assert s.instance_for_epoch(99).harmony_nodes_per_shard == 170
-    assert s.instance_for_epoch(100).harmony_nodes_per_shard == 130
-    assert s.instance_for_epoch(1200).num_shards == 2
+    assert s.instance_for_epoch(207).harmony_nodes_per_shard == 170
+    assert s.instance_for_epoch(208).harmony_nodes_per_shard == 130
+    assert s.instance_for_epoch(1673).num_shards == 2
     v5 = s.instance_for_epoch(10**6)
     assert v5.harmony_vote_percent.equal(Dec.from_str("0.01"))
     assert v5.external_vote_percent().equal(Dec.from_str("0.99"))
-    assert v5.external_slots_per_shard() == 150
+    assert v5.external_slots_per_shard() == 198
     assert v5.total_slots() == 400
 
 

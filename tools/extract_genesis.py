@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Extract the reference's genesis account tables into a data artifact.
+
+The reference carries its mainnet/testnet genesis committees as Go
+source (reference: internal/genesis/*.go, ~7k lines of DeployAccount
+literals).  Those are CHAIN CONSTANTS — public addresses + BLS pubkeys
+that any parity implementation must agree on byte-for-byte — so this
+tool transcribes them once into
+harmony_tpu/config/genesis_accounts.json.gz and the framework loads
+the artifact (harmony_tpu/config/genesis_accounts.py).
+
+Rerun after a reference update:
+    python tools/extract_genesis.py [/path/to/reference]
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import sys
+
+_TABLE_RE = re.compile(
+    r"var\s+(\w+)\s*=\s*\[\]DeployAccount\s*\{(.*?)\n\}", re.S
+)
+_ENTRY_RE = re.compile(
+    r'Index:\s*"\s*([\d]+)\s*"\s*,\s*Address:\s*"(\w+)"\s*,'
+    r'\s*BLSPublicKey:\s*"([0-9a-fA-F]+)"'
+)
+
+FILES = (
+    "foundational.go",
+    "harmony.go",
+    "localnodes.go",
+    "newnodes.go",
+    "tn_harmony.go",
+    "pangaea.go",
+    "foundational_pangaea.go",
+)
+
+
+def extract(ref_dir: str) -> dict:
+    tables: dict[str, list] = {}
+    gen_dir = os.path.join(ref_dir, "internal", "genesis")
+    for fname in FILES:
+        path = os.path.join(gen_dir, fname)
+        if not os.path.exists(path):
+            continue
+        src = open(path).read()
+        for m in _TABLE_RE.finditer(src):
+            name, body = m.group(1), m.group(2)
+            entries = [
+                {"index": int(e.group(1)), "address": e.group(2),
+                 "bls": e.group(3).lower()}
+                for e in _ENTRY_RE.finditer(body)
+            ]
+            if entries:
+                tables[name] = entries
+    return tables
+
+
+def main() -> int:
+    ref = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    tables = extract(ref)
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "harmony_tpu", "config", "genesis_accounts.json.gz",
+    )
+    blob = json.dumps(tables, separators=(",", ":"), sort_keys=True)
+    with gzip.open(out, "wb", compresslevel=9) as f:
+        f.write(blob.encode())
+    total = sum(len(v) for v in tables.values())
+    print(f"{len(tables)} tables, {total} accounts -> {out} "
+          f"({os.path.getsize(out)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
