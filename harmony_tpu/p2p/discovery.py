@@ -19,17 +19,81 @@ rate-limited addresses stay unreachable exactly as for inbound peers.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from ..log import get_logger
+from ..ref.keccak import keccak256
 from .host import TCPHost
 
 _log = get_logger("discovery")
 
 
+class RoutingTable:
+    """Kademlia-style k-buckets over peer ADDRESSES (node id =
+    keccak(addr)): known peers sorted into 256 buckets by XOR-distance
+    prefix from our own id, k entries per bucket.  Guarantees the
+    stored view spans the WHOLE id space instead of clustering around
+    whoever answered PEX first — the property that makes iterative
+    closest-first lookups converge in O(log N) steps (the role of
+    libp2p's dht routing table under reference:
+    p2p/discovery/discovery.go:41-79)."""
+
+    K = 16
+
+    def __init__(self, my_addr: str):
+        self.my_id = int.from_bytes(keccak256(my_addr.encode()), "big")
+        self._buckets: list[list] = [[] for _ in range(256)]
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _id(addr: str) -> int:
+        return int.from_bytes(keccak256(addr.encode()), "big")
+
+    def _bucket_of(self, addr: str) -> int:
+        d = self._id(addr) ^ self.my_id
+        return d.bit_length() - 1 if d else 0
+
+    def add(self, addr: str) -> bool:
+        """Insert (LRU within the bucket); full buckets evict the
+        oldest entry (no liveness ping on this transport — PEX entries
+        are refreshed every pull)."""
+        with self._lock:
+            b = self._buckets[self._bucket_of(addr)]
+            if addr in b:
+                b.remove(addr)
+            elif len(b) >= self.K:
+                b.pop(0)
+            b.append(addr)
+            return True
+
+    def remove(self, addr: str):
+        with self._lock:
+            b = self._buckets[self._bucket_of(addr)]
+            if addr in b:
+                b.remove(addr)
+
+    def closest(self, target: bytes, k: int = K) -> list:
+        t = int.from_bytes(target, "big")
+        with self._lock:
+            allv = [a for b in self._buckets for a in b]
+        allv.sort(key=lambda a: self._id(a) ^ t)
+        return allv[:k]
+
+    def random_target(self) -> bytes:
+        """A uniformly random id — refresh lookups probe the sparse
+        regions the PEX gossip never reaches organically."""
+        return os.urandom(32)
+
+    def __len__(self):
+        with self._lock:
+            return sum(len(b) for b in self._buckets)
+
+
 class Discovery:
-    """PEX maintenance loop for one host."""
+    """Routed discovery: k-bucket table + PEX pulls + iterative
+    random-target lookups, dialing toward ``target_peers``."""
 
     def __init__(self, host: TCPHost, bootnodes: list | None = None,
                  target_peers: int = 8, interval: float = 2.0):
@@ -37,9 +101,11 @@ class Discovery:
         self.bootnodes = list(bootnodes or [])
         self.target_peers = target_peers
         self.interval = interval
+        self.table = RoutingTable(f"127.0.0.1:{host.port}")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.dials = 0
+        self._rounds = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -67,17 +133,32 @@ class Discovery:
 
     def step(self):
         """One maintenance round (callable directly from tests)."""
+        me = self._my_addr()
         if self.host.peer_count() == 0 and self.bootnodes:
             for b in self.bootnodes:
                 self._dial(b)
-        if self.host.peer_count() >= self.target_peers:
-            return
-        # pull fresh addresses, then dial the ones we are not holding a
-        # connection to (self excluded)
-        self.host.request_peers()
-        connected = self.host.connected_addrs()
-        me = self._my_addr()
+        # fold everything the host has learned into the k-buckets
         for addr in list(self.host.known_addrs):
+            if addr != me:
+                self.table.add(addr)
+        if self.host.peer_count() >= self.target_peers:
+            # table refresh only: a routed lookup toward a random
+            # region every few rounds keeps bucket coverage broad
+            self._rounds += 1
+            if self._rounds % 4 == 0:
+                self.host.request_peers(self.table.random_target())
+            return
+        # below target: plain PEX pull + a routed lookup toward our own
+        # id (closest-first fills our nearest buckets — the peers best
+        # placed to answer future lookups for us)
+        self.host.request_peers()
+        self.host.request_peers(
+            keccak256(me.encode())
+        )
+        connected = self.host.connected_addrs()
+        # dial closest-first from the routing table: deterministic
+        # convergence instead of whatever order PEX happened to learn
+        for addr in self.table.closest(keccak256(me.encode()), k=64):
             if self.host.peer_count() >= self.target_peers:
                 break
             if addr == me or addr in connected or addr in self.bootnodes:
@@ -87,6 +168,8 @@ class Discovery:
                 # one dial per step per address; connection handshake
                 # (HELLO+ADVERT) lands asynchronously
                 connected.add(addr)
+            else:
+                self.table.remove(addr)  # dead address: drop the entry
 
     def _loop(self):
         while not self._stop.is_set():

@@ -44,12 +44,18 @@ def test_inprocess_gossip_validate_and_deliver():
     assert got_c == [b"hello"]  # validator filtered "bad"
 
 
-def test_tcp_gossip_flood_and_dedup():
+def test_tcp_gossip_relay_and_dedup():
     h1 = TCPHost("n1")
     h2 = TCPHost("n2")
     h3 = TCPHost("n3")
     try:
-        # line topology: n1 - n2 - n3; flood must transit n2
+        # line topology: n1 - n2 - n3; the message must transit n2.
+        # Mesh semantics (gossipsub, like the reference): only peers
+        # participating in a topic relay it — n2 registers a validator
+        # (the relay posture every shard node has for its topics)
+        from harmony_tpu.p2p.host import ACCEPT as _A
+
+        h2.add_validator("x", lambda p, f: _A)
         h2.connect(h1.port)
         h3.connect(h2.port)
         assert h1.wait_for_peers(1) and h3.wait_for_peers(1)
