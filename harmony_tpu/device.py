@@ -57,6 +57,8 @@ class CommitteeTable:
         self._dev = None
 
     def device_array(self):
+        if kernel_twin_active():
+            return self._np  # twins are numpy-native; keep jax unloaded
         import jax.numpy as jnp
 
         if self._dev is None:
@@ -137,8 +139,31 @@ _agg_verify_fn = None
 _agg_verify_batch_fn = None
 
 
+def kernel_twin_active() -> bool:
+    """HARMONY_KERNEL_TWIN=1 swaps the XLA kernels for the bigint/
+    native-backed twins (ops/twin.py): a LIVE node exercises every
+    device-path layer — table padding, bitmap routing, COUNTERS, batch
+    chunking — on hosts where XLA:CPU pairing execution is measured in
+    minutes.  The kernel math stays covered by the ops parity tier."""
+    import os
+
+    return os.environ.get("HARMONY_KERNEL_TWIN") == "1"
+
+
+def _kernels():
+    if kernel_twin_active():
+        from .ops import twin as T
+
+        return T
+    from .ops import bls as OB
+
+    return OB
+
+
 def _get_verify_fn():
     global _verify_fn
+    if kernel_twin_active():
+        return _kernels().verify
     if _verify_fn is None:
         import jax
 
@@ -150,6 +175,8 @@ def _get_verify_fn():
 
 def _get_agg_verify_fn():
     global _agg_verify_fn
+    if kernel_twin_active():
+        return _kernels().agg_verify
     if _agg_verify_fn is None:
         import jax
 
@@ -161,6 +188,8 @@ def _get_agg_verify_fn():
 
 def _get_agg_verify_batch_fn():
     global _agg_verify_batch_fn
+    if kernel_twin_active():
+        return _kernels().agg_verify_batch
     if _agg_verify_batch_fn is None:
         import jax
 
@@ -176,7 +205,10 @@ def _fused() -> bool:
     minutes of LLVM time (see docs/NOTES_r2.md), so the CPU route runs
     the SAME ops eagerly — op-by-op dispatch reuses small in-process
     kernel caches, the path the ops suite exercises in seconds.  Same
-    math, same counters, zero big executables."""
+    math, same counters, zero big executables.  Twin kernels take the
+    'fused' branch (they are plain python callables either way)."""
+    if kernel_twin_active():
+        return True
     import jax
 
     return jax.default_backend() != "cpu"
@@ -188,22 +220,28 @@ def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
     bitmap in, bool out — masked G1 tree-sum AND the 2-pairing product
     with no host affine round-trip (reference semantics:
     internal/chain/engine.go:619-642 in one shot)."""
-    import jax.numpy as jnp
     import numpy as np
 
     from .ops import interop as I
     from .ref.hash_to_curve import hash_to_g2
 
-    from .ops import bls as OB
+    if kernel_twin_active():
+        asarray = np.asarray
+        OB = None  # twins only: jax stays unloaded
+    else:
+        import jax.numpy as jnp
 
+        from .ops import bls as OB
+
+        asarray = jnp.asarray
     h = hash_to_g2(payload)
     COUNTERS["agg_verify"] += 1
     fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
     ok = fn(
         table.device_array(),
-        jnp.asarray(table.pad_bits(bits)),
-        jnp.asarray(I.g2_affine_to_arr(h)),
-        jnp.asarray(I.g2_affine_to_arr(sig_point)),
+        asarray(table.pad_bits(bits)),
+        asarray(I.g2_affine_to_arr(h)),
+        asarray(I.g2_affine_to_arr(sig_point)),
     )
     return bool(np.asarray(ok))
 
@@ -233,12 +271,19 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
     chunked to pinned batch widths — each chunk is ONE program (masked
     tree-sums + pairing checks together).  h_points are pre-hashed
     payload points (host hash-to-G2); returns list[bool]."""
-    import jax.numpy as jnp
     import numpy as np
 
-    from .ops import bls as OB
     from .ops import interop as I
 
+    if kernel_twin_active():
+        asarray = np.asarray
+        OB = None  # twins only: jax stays unloaded
+    else:
+        import jax.numpy as jnp
+
+        from .ops import bls as OB
+
+        asarray = jnp.asarray
     results = []
     widest = batch_buckets()[-1]
     fn = _get_agg_verify_batch_fn() if _fused() else OB.agg_verify_batch
@@ -252,7 +297,7 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
         bm = np.stack([table.pad_bits(chunk_bits[i]) for i in sel])
         hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
         sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
-        ok = fn(tbl, jnp.asarray(bm), jnp.asarray(hh), jnp.asarray(sg))
+        ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
         COUNTERS["batch_verify"] += 1
         results.extend(bool(x) for x in np.asarray(ok)[:n])
     return results
@@ -266,23 +311,30 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
     pk_point: reference affine G1 point; sig_point: affine G2 point;
     payload: signed bytes (hash-to-G2 stays host-side per SURVEY §7.2).
     """
-    import jax.numpy as jnp
     import numpy as np
 
     from .ops import interop as I
     from .ref.hash_to_curve import hash_to_g2
 
-    from .ops import bls as OB
+    if kernel_twin_active():
+        asarray = np.asarray
+        OB = None  # twins only: jax stays unloaded
+    else:
+        import jax.numpy as jnp
 
+        from .ops import bls as OB
+
+        asarray = jnp.asarray
     h = hash_to_g2(payload)
     # fused: pad to the pinned bucket so one compiled program serves
     # every single check; eager (CPU): width 1, no padding — each lane
-    # would re-run the whole pairing op-by-op
-    width = _VERIFY_BUCKET if _fused() else 1
+    # would re-run the whole pairing op-by-op.  Twin kernels skip the
+    # padding: each lane costs a real host check
+    width = _VERIFY_BUCKET if _fused() and not kernel_twin_active() else 1
     pk = np.asarray(I.g1_batch_affine([pk_point] * width))
     hh = np.asarray(I.g2_batch_affine([h] * width))
     sg = np.asarray(I.g2_batch_affine([sig_point] * width))
     fn = _get_verify_fn() if _fused() else OB.verify
-    ok = fn(jnp.asarray(pk), jnp.asarray(hh), jnp.asarray(sg))
+    ok = fn(asarray(pk), asarray(hh), asarray(sg))
     COUNTERS["verify"] += 1
     return bool(np.asarray(ok)[0])

@@ -124,7 +124,35 @@ class Registry:
     def expose(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
-        return "\n".join(m.expose() for m in metrics) + "\n"
+        lines = [m.expose() for m in metrics]
+        lines.append(self._device_counters())
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _device_counters() -> str:
+        """Device-path liveness (device.COUNTERS): lets a localnet run
+        ASSERT over HTTP that quorum checks executed on the device
+        path (VERDICT r4 #3 — the flagship path must carry real
+        consensus, observably)."""
+        from . import device as DV
+
+        out = [
+            "# HELP harmony_device_checks_total verification checks "
+            "executed on the device path",
+            "# TYPE harmony_device_checks_total counter",
+        ]
+        for kind, v in sorted(DV.COUNTERS.items()):
+            out.append(
+                f'harmony_device_checks_total{{kind="{kind}"}} {v}'
+            )
+        out.append(
+            "# HELP harmony_device_kernel_twin device kernels are the "
+            "host-backed twins (1) vs XLA (0)\n"
+            "# TYPE harmony_device_kernel_twin gauge\n"
+            f"harmony_device_kernel_twin "
+            f"{1 if DV.kernel_twin_active() else 0}"
+        )
+        return "\n".join(out)
 
 
 def _pprof_stacks() -> str:

@@ -1,0 +1,71 @@
+"""LIVE consensus through the device path (VERDICT r4 #3).
+
+A real Node commits real blocks with device.py FORCED ON: every quorum
+proof runs through CommitteeTable + agg_verify_on_device and the
+COUNTERS observably increment.  Kernels are the host-backed twins
+(HARMONY_KERNEL_TWIN=1, ops/twin.py) — the layer split of
+test_device_path.py, but carried by actual FBFT rounds instead of
+hand-fed arrays.  tools/localnet.py --device-path is the subprocess
+variant of this scenario (counters asserted over /metrics)."""
+
+import pytest
+
+from harmony_tpu import device as DV
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.node.node import Node
+from harmony_tpu.node.registry import Registry
+from harmony_tpu.ops import twin
+from harmony_tpu.p2p import InProcessNetwork
+
+CHAIN_ID = 2
+
+
+@pytest.fixture
+def device_forced(monkeypatch):
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    DV.use_device(True)
+    yield
+    DV.use_device(None)
+
+
+def test_live_rounds_traverse_device_path(device_forced):
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=4)
+    net = InProcessNetwork()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    reg = Registry(blockchain=chain, txpool=pool, host=net.host("solo"))
+    node = Node(reg, PrivateKeys.from_keys(bls_keys))
+
+    before = dict(DV.COUNTERS)
+    twin_before = dict(twin.CALLS)
+    for _ in range(3):
+        node.start_round_if_leader()
+    assert chain.head_number == 3, "device-path rounds must commit"
+    grew = DV.COUNTERS["agg_verify"] - before["agg_verify"]
+    assert grew > 0, (before, DV.COUNTERS)
+    # the counters were backed by real twin-kernel invocations (the
+    # device arrays actually flowed, not just the counter line)
+    assert twin.CALLS["agg_verify"] - twin_before["agg_verify"] >= grew
+    # committee bucket 8: the 4-key committee pads to the first bucket
+    tbl = DV.get_committee_table(
+        tuple(k.pub.bytes for k in bls_keys),
+        [k.pub.point for k in bls_keys],
+    )
+    assert tbl.size == 8 and tbl.n == 4
+
+
+def test_device_metrics_exposition(device_forced):
+    from harmony_tpu.metrics import Registry as MetricsRegistry
+
+    base = DV.COUNTERS["agg_verify"]
+    DV.COUNTERS["agg_verify"] = base + 1
+    try:
+        text = MetricsRegistry().expose()
+    finally:
+        DV.COUNTERS["agg_verify"] = base
+    assert 'harmony_device_checks_total{kind="agg_verify"}' in text
+    assert "harmony_device_kernel_twin 1" in text
