@@ -1,0 +1,54 @@
+"""AOT program artifacts: load-and-call for the exported quorum checks.
+
+tools/aot_export.py serializes the production-shape jitted programs
+(tracing + StableHLO emission, no backend needed); this module loads
+them on an accelerator so the FIRST device contact compiles from the
+artifact's lowering instead of re-tracing Python (VERDICT r4 #2 — the
+TPU budget must go to measuring, not compiling).  Absent artifacts
+fall back to plain jax.jit transparently.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "artifacts", "aot",
+)
+
+_cache: dict = {}
+_lock = threading.Lock()
+
+
+def load(name: str):
+    """The exported program's ``call`` for ``name`` (e.g.
+    ``agg_verify_b8``), or None when no artifact is shipped."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+    call = None
+    for suffix, opener in ((".jaxexport", open),
+                           (".jaxexport.gz", None)):
+        path = os.path.join(_DIR, name + suffix)
+        if not os.path.exists(path):
+            continue
+        try:
+            from jax import export as jexport
+
+            if opener is None:
+                import gzip
+
+                with gzip.open(path, "rb") as f:
+                    blob = f.read()
+            else:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            call = jexport.deserialize(blob).call
+            break
+        except Exception:  # noqa: BLE001 — stale/foreign artifact: jit
+            call = None
+    with _lock:
+        _cache[name] = call
+    return call

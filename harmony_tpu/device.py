@@ -169,7 +169,18 @@ def _get_verify_fn():
 
         from .ops import bls as OB
 
-        _verify_fn = jax.jit(OB.verify)
+        jitted = jax.jit(OB.verify)
+
+        def dispatch(pk, hh, sg):
+            if jax.default_backend() != "cpu":
+                from . import aot
+
+                call = aot.load(f"verify_w{pk.shape[0]}")
+                if call is not None:
+                    return call(pk, hh, sg)
+            return jitted(pk, hh, sg)
+
+        _verify_fn = dispatch
     return _verify_fn
 
 
@@ -182,7 +193,20 @@ def _get_agg_verify_fn():
 
         from .ops import bls as OB
 
-        _agg_verify_fn = jax.jit(OB.agg_verify)
+        jitted = jax.jit(OB.agg_verify)
+
+        def dispatch(tbl, bits, h, sig):
+            # accelerator: prefer the AOT artifact for this bucket so
+            # first contact compiles from the shipped lowering
+            if jax.default_backend() != "cpu":
+                from . import aot
+
+                call = aot.load(f"agg_verify_b{tbl.shape[0]}")
+                if call is not None:
+                    return call(tbl, bits, h, sig)
+            return jitted(tbl, bits, h, sig)
+
+        _agg_verify_fn = dispatch
     return _agg_verify_fn
 
 
@@ -195,7 +219,20 @@ def _get_agg_verify_batch_fn():
 
         from .ops import bls as OB
 
-        _agg_verify_batch_fn = jax.jit(OB.agg_verify_batch)
+        jitted = jax.jit(OB.agg_verify_batch)
+
+        def dispatch(tbl, bm, hh, sg):
+            if jax.default_backend() != "cpu":
+                from . import aot
+
+                call = aot.load(
+                    f"agg_verify_batch_b{tbl.shape[0]}x{bm.shape[0]}"
+                )
+                if call is not None:
+                    return call(tbl, bm, hh, sg)
+            return jitted(tbl, bm, hh, sg)
+
+        _agg_verify_batch_fn = dispatch
     return _agg_verify_batch_fn
 
 

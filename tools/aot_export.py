@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""AOT-export the production quorum-check programs (VERDICT r4 #2).
+
+Every round so far burned its only TPU contact on COMPILING the
+pairing programs instead of measuring them.  ``jax.export`` lowers a
+jitted function to serialized StableHLO without touching any backend
+(tracing + emission only — seconds on CPU), and the artifact carries a
+TPU lowering: the first live relay contact deserializes and compiles
+on the TPU toolchain (fast) instead of re-tracing Python, and bench.py
+measures inside its budget.
+
+Exports (the pinned production shapes of device.py):
+  agg_verify     at every committee bucket   (the FBFT quorum check)
+  verify         at the width-8 lane bucket  (single signature checks)
+  agg_verify_batch at (1024-key table x 64)  (the replay shape)
+
+Run:  python tools/aot_export.py [--out DIR]
+Load: jax.export.deserialize(path.read_bytes()).call(*args)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_OUT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "artifacts", "aot"
+)
+
+# committee buckets worth shipping (device.py COMMITTEE_BUCKETS; 1024
+# covers the BASELINE 1000-key config)
+AGG_BUCKETS = (8, 16, 32, 64, 128, 256, 1024)
+REPLAY_SHAPE = (1024, 64)  # (committee bucket, batch lanes)
+
+
+def export_all(out_dir: str) -> list:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # lowering needs no device
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from harmony_tpu.ops import bls as OB
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    def emit(name: str, fn, *specs):
+        import gzip
+
+        path = os.path.join(out_dir, name + ".jaxexport.gz")
+        if os.path.exists(path) or os.path.exists(path[:-3]):
+            print(f"  {name}: exists, skipped")
+            return
+        exp = jexport.export(
+            jax.jit(fn), platforms=("tpu", "cpu")
+        )(*specs)
+        blob = exp.serialize()
+        with gzip.open(path, "wb", compresslevel=9) as f:
+            f.write(blob)
+        written.append((name, len(blob)))
+        print(f"  {name}: {len(blob):,} bytes")
+
+    i32 = jnp.int32
+
+    def S(shape):
+        return jax.ShapeDtypeStruct(shape, i32)
+
+    for n in AGG_BUCKETS:
+        emit(
+            f"agg_verify_b{n}", OB.agg_verify,
+            S((n, 2, 32)), S((n,)), S((2, 2, 32)), S((2, 2, 32)),
+        )
+    emit(
+        "verify_w8", OB.verify,
+        S((8, 2, 32)), S((8, 2, 2, 32)), S((8, 2, 2, 32)),
+    )
+    n, b = REPLAY_SHAPE
+    emit(
+        f"agg_verify_batch_b{n}x{b}", OB.agg_verify_batch,
+        S((n, 2, 32)), S((b, n)), S((b, 2, 2, 32)), S((b, 2, 2, 32)),
+    )
+    return written
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    args = ap.parse_args()
+    written = export_all(args.out)
+    total = sum(sz for _, sz in written)
+    print(f"{len(written)} artifacts, {total:,} bytes -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
