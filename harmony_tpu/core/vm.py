@@ -523,6 +523,76 @@ class StructLogTracer(CallTracer):
         self.logs.append(entry)
 
 
+class NoopTracer(CallTracer):
+    """noopTracer: accepts every hook, returns {} — the liveness probe
+    tracer (reference: eth/tracers js noop tracer)."""
+
+    @property
+    def result(self):
+        return {}
+
+
+class OpcountTracer(CallTracer):
+    """opcountTracer: total executed opcode count (reference:
+    eth/tracers' opcount JS tracer, served by name)."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def step(self, pc, op, gas, depth, stack, mem_size):
+        self.count += 1
+
+    @property
+    def result(self):
+        return self.count
+
+
+class FourByteTracer(CallTracer):
+    """4byteTracer: function-selector usage — {"0xselector-argsize":
+    count} over every call frame carrying >= 4 bytes of input
+    (reference: eth/tracers' 4byte tracer output shape)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ids: dict[str, int] = {}
+
+    def enter(self, typ, frm, to, value, gas, data):
+        super().enter(typ, frm, to, value, gas, data)
+        if typ != "CREATE" and len(data) >= 4:
+            key = f"0x{data[:4].hex()}-{len(data) - 4}"
+            self.ids[key] = self.ids.get(key, 0) + 1
+
+    @property
+    def result(self):
+        return self.ids
+
+
+class NgramTracer(CallTracer):
+    """unigram/bigram/trigramTracer: opcode n-gram histograms
+    (reference: eth/tracers' unigram/bigram/trigram JS tracers — the
+    profiling family served by name)."""
+
+    def __init__(self, n: int):
+        super().__init__()
+        self.n = n
+        self.hist: dict[str, int] = {}
+        self._window: list[str] = []
+
+    def step(self, pc, op, gas, depth, stack, mem_size):
+        name = OPCODE_NAMES.get(op, f"0x{op:02x}")
+        self._window.append(name)
+        if len(self._window) > self.n:
+            self._window.pop(0)
+        if len(self._window) == self.n:
+            key = "-".join(self._window)
+            self.hist[key] = self.hist.get(key, 0) + 1
+
+    @property
+    def result(self):
+        return self.hist
+
+
 class PrestateTracer(CallTracer):
     """prestateTracer (reference: eth/tracers/native/prestate.go):
     records each touched account's balance/nonce/code and every

@@ -634,7 +634,8 @@ class RPCServer:
         state EVOLVES (value moves, storage writes, nonce bump, fee
         debit), so a block-level caller chains txs cumulatively."""
         from ..core.vm import (
-            EVM, CallTracer, Env, PrestateTracer, StructLogTracer,
+            EVM, CallTracer, Env, FourByteTracer, NgramTracer,
+            NoopTracer, OpcountTracer, PrestateTracer, StructLogTracer,
         )
 
         which = opts.get("tracer", "")
@@ -642,10 +643,22 @@ class RPCServer:
         sender = tx.sender(chain_id)
         env = Env(block_num=num, chain_id=chain_id,
                   shard_id=self.hmy.shard_id())
-        if which == "callTracer":
-            tracer = CallTracer()
-        elif which == "prestateTracer":
-            tracer = PrestateTracer(state)
+        # the reference serves these by NAME via its JS tracer engine
+        # (hmy/tracers); here they are native implementations with the
+        # same output shapes.  Arbitrary inline-JS tracers are a
+        # deliberate non-goal (PARITY.md): RPC-supplied code execution.
+        named = {
+            "callTracer": lambda: CallTracer(),
+            "prestateTracer": lambda: PrestateTracer(state),
+            "noopTracer": NoopTracer,
+            "opcountTracer": OpcountTracer,
+            "4byteTracer": FourByteTracer,
+            "unigramTracer": lambda: NgramTracer(1),
+            "bigramTracer": lambda: NgramTracer(2),
+            "trigramTracer": lambda: NgramTracer(3),
+        }
+        if which in named:
+            tracer = named[which]()
         elif not which:
             tracer = StructLogTracer(
                 with_stack=not (
@@ -696,6 +709,8 @@ class RPCServer:
             return tracer.root
         if which == "prestateTracer":
             return tracer.accounts
+        if which:  # named profiling tracers expose .result
+            return tracer.result
         result = {
             "gas": intrinsic + (budget - gas_left),
             "failed": not ok,

