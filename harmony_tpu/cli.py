@@ -17,7 +17,11 @@ import os
 import signal
 import sys
 import threading
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is the same parser
+    import tomli as tomllib
 
 from .chain.engine import Engine, EpochContext
 from .config.chain import ChainConfig
@@ -136,8 +140,11 @@ def _open_db(cfg: dict):
             from .core.kv_native import NativeKV
 
             return NativeKV(db_path)
-        except Exception:
-            pass
+        except Exception as e:  # documented above: ANY native failure
+            get_logger("cli").warn(
+                "native kv unavailable, using FileKV twin",
+                path=db_path, error=str(e),
+            )
     return FileKV(db_path)
 
 

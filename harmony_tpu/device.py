@@ -75,6 +75,7 @@ class CommitteeTable:
 
 _TABLE_CACHE: "dict[tuple, CommitteeTable]" = {}
 _TABLE_CACHE_CAP = 8
+_TABLE_CACHE_LOCK = threading.Lock()
 
 
 def get_committee_table(serialized_keys, points) -> CommitteeTable:
@@ -82,14 +83,23 @@ def get_committee_table(serialized_keys, points) -> CommitteeTable:
     round, but the committee changes only at epoch boundaries — the
     host->device conversion must amortize across rounds, not re-run
     per block.  Keyed by the serialized key tuple; bounded (a node
-    tracks at most its own + a few foreign committees at once)."""
+    tracks at most its own + a few foreign committees at once).
+
+    Locked: consensus, view-change and replay threads all reach this
+    cache; eviction (pop during another thread's insert) must not race.
+    The CommitteeTable build itself runs outside the lock — it is the
+    expensive host->device conversion, and a duplicate build loses only
+    work, not correctness."""
     key = tuple(serialized_keys)
-    tbl = _TABLE_CACHE.get(key)
+    with _TABLE_CACHE_LOCK:
+        tbl = _TABLE_CACHE.get(key)
     if tbl is None:
         tbl = CommitteeTable(points)
-        if len(_TABLE_CACHE) >= _TABLE_CACHE_CAP:
-            _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
-        _TABLE_CACHE[key] = tbl
+        with _TABLE_CACHE_LOCK:
+            if (key not in _TABLE_CACHE
+                    and len(_TABLE_CACHE) >= _TABLE_CACHE_CAP):
+                _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
+            tbl = _TABLE_CACHE.setdefault(key, tbl)
     return tbl
 
 

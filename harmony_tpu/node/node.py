@@ -396,10 +396,12 @@ class Node:
             return
         try:
             handler(msg)
-        except Exception:
+        except Exception as e:
             # tolerant message loop (the reference logs and moves on):
             # one malformed message must never kill the consensus pump
             self.dropped_messages += 1
+            self.log.warn("consensus message dropped",
+                          msg_type=int(msg.msg_type), error=str(e))
 
     # -- FBFT phase handlers ------------------------------------------------
 

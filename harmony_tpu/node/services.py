@@ -9,6 +9,10 @@ from __future__ import annotations
 
 from enum import IntEnum
 
+from ..log import get_logger
+
+_log = get_logger("services")
+
 
 class ServiceType(IntEnum):
     """reference: api/service/manager.go:57-63 service type ids."""
@@ -65,8 +69,12 @@ class Manager:
             for svc in reversed(started):
                 try:
                     svc.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # rollback must reach every started service, and
+                    # stop_fn callbacks can raise anything: log, keep
+                    # rolling back, re-raise the original start failure
+                    _log.warn("service stop failed during rollback",
+                              service=type(svc).__name__, error=str(e))
             raise
 
     def stop_services(self):
@@ -74,8 +82,11 @@ class Manager:
         for _, svc in reversed(self._services):
             try:
                 svc.stop()
-            except Exception:
-                pass
+            except Exception as e:
+                # shutdown must reach every service, and stop_fn
+                # callbacks can raise anything: log and move on
+                _log.warn("service stop failed during shutdown",
+                          service=type(svc).__name__, error=str(e))
         self._running = False
 
     @property

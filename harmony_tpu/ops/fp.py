@@ -99,7 +99,7 @@ def _sub_exact(x, y):
     borrow_in = _lookahead(gen, prop)
     out = (d - borrow_in) & LIMB_MASK
     last = d[..., -1] - borrow_in[..., -1]
-    borrow_out = jnp.where(last < 0, 1, 0)
+    borrow_out = jnp.where(last < 0, 1, 0).astype(d.dtype)
     return out, borrow_out
 
 

@@ -71,7 +71,7 @@ def _resolve_binary_carries(s):
     """Kogge-Stone carry lookahead along the sublane (limb) axis for
     limbs <= 2^13 - 1."""
     g = s >> LIMB_BITS
-    p = jnp.where((s & LIMB_MASK) == LIMB_MASK, 1, 0)
+    p = jnp.where((s & LIMB_MASK) == LIMB_MASK, 1, 0).astype(s.dtype)
     for d in (1, 2, 4, 8, 16):
         g = g | (p & _shift_down_sublanes(g, d))
         p = p & _shift_down_sublanes(p, d)
@@ -80,8 +80,8 @@ def _resolve_binary_carries(s):
 
 
 def _borrow_lookahead(d):
-    g = jnp.where(d < 0, 1, 0)
-    p = jnp.where(d == 0, 1, 0)
+    g = jnp.where(d < 0, 1, 0).astype(d.dtype)
+    p = jnp.where(d == 0, 1, 0).astype(d.dtype)
     for dist in (1, 2, 4, 8, 16):
         g = g | (p & _shift_down_sublanes(g, dist))
         p = p & _shift_down_sublanes(p, dist)

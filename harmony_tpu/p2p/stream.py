@@ -226,8 +226,9 @@ class SyncClient:
         self._lock = threading.Lock()
 
     def _connect(self):
+        # only called from _call, which already holds self._lock
         if self._sock is None:
-            self._sock = socket.create_connection(
+            self._sock = socket.create_connection(  # graftlint: disable=GL03
                 self._addr, timeout=self._timeout
             )
 

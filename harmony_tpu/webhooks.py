@@ -15,6 +15,10 @@ import threading
 import urllib.request
 from collections import deque
 
+from .log import get_logger
+
+_log = get_logger("webhooks")
+
 
 class Hooks:
     """Named event -> list of callables(payload dict)."""
@@ -38,8 +42,10 @@ class Hooks:
         for fn in self._hooks.get(event, ()):
             try:
                 fn(payload)
-            except Exception:
-                pass
+            except Exception as e:  # any hook bug: log, never propagate
+                _log.warn("webhook hook raised", event=event,
+                          hook=getattr(fn, "__name__", repr(fn)),
+                          error=str(e))
 
 
 def http_post_hook(url: str, timeout: float = 5.0):

@@ -1,0 +1,94 @@
+"""GL01 fixtures: jit purity — positive, suppressed, and clean cases.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+"""
+
+import functools
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+STATE = {"calls": 0}
+
+
+@jax.jit
+def impure_time(x):
+    t = time.time()  # expect: GL01
+    return x + t
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def impure_print(x, n):
+    print("tracing", n)  # expect: GL01
+    return x * n
+
+
+@jax.jit
+def impure_host_sync(x):
+    y = np.asarray(x)  # expect: GL01
+    return x + y.item()  # expect: GL01
+
+
+@jax.jit
+def impure_global(x):
+    global STATE  # expect: GL01
+    STATE = {"calls": 1}
+    return x
+
+
+@jax.jit
+def impure_attr(obj, x):
+    obj.cache = x  # expect: GL01
+    return x
+
+
+@jax.jit
+def impure_random(x):
+    return x + random.random()  # expect: GL01
+
+
+@jax.jit
+def suppressed_ok(x):
+    print("reviewed: trace-time only")  # graftlint: disable=GL01
+    return x
+
+
+@jax.jit
+def wrong_suppression(x):
+    print("still flagged")  # graftlint: disable=GL02  # expect: GL01
+    return x
+
+
+def helper_step(carry, x):
+    time.sleep(0)  # expect: GL01
+    return carry + x, None
+
+
+def uses_scan(xs):
+    return jax.lax.scan(helper_step, 0, xs)
+
+
+def kernel(in_ref, out_ref):
+    out_ref[:, :] = in_ref[:, :] * 2  # ref store: the Pallas idiom, clean
+    print("kernel side effect")  # expect: GL01
+
+
+def call_kernel(x):
+    return pl.pallas_call(kernel, out_shape=None)(x)
+
+
+@jax.jit
+def pure_fn(x):
+    y = jnp.zeros_like(x)
+    return x + y
+
+
+def plain_function(x):
+    # not traced: host-side impurity is fine
+    print("host-side logging", time.time())
+    return x

@@ -1,0 +1,38 @@
+"""GL02 fixtures: limb-dtype discipline — positive, suppressed, clean.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+"""
+
+import jax.numpy as jnp
+
+GOOD_TABLE = jnp.asarray([1, 2, 3], dtype=jnp.int32)
+BAD_TABLE = jnp.asarray([1, 2, 3])  # expect: GL02
+BAD_ARRAY = jnp.array((4, 5))  # expect: GL02
+BAD_COMP = jnp.asarray([i & 1 for i in range(8)])  # expect: GL02
+
+
+def make_masks(converted_limbs):
+    typed = jnp.zeros(32, dtype=jnp.int32)
+    untyped = jnp.zeros(32)  # expect: GL02
+    untyped_full = jnp.full(32, 7)  # expect: GL02
+    from_var = jnp.asarray(converted_limbs)  # dtype unknowable: clean
+    return typed, untyped, untyped_full, from_var
+
+
+def weak_where(x):
+    disciplined = jnp.where(x > 0, 1, 0).astype(x.dtype)
+    weak = jnp.where(x > 0, 1, 0)  # expect: GL02
+    reviewed = jnp.where(x > 0, 1, 0)  # graftlint: disable=GL02
+    named_operands = jnp.where(x > 0, x, -x)
+    return disciplined, weak, reviewed, named_operands
+
+
+def float_leak(x):
+    scale = 1.5  # expect: GL02
+    return x * scale
+
+
+def reason_suffix(x):
+    # a justification after the rule id must still suppress
+    return jnp.where(x > 0, 1, 0)  # graftlint: disable=GL02 weak-by-design

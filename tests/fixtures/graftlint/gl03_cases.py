@@ -1,0 +1,91 @@
+"""GL03 fixtures: lock discipline — positive, suppressed, clean.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+"""
+
+import threading
+
+COUNTS = {"hits": 0}
+_CACHE: dict = {}
+_LOCK = threading.Lock()
+_G = 0
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []
+        self.total = 0
+        self.closed = False  # never written under the lock: unguarded
+
+    def put(self, item):
+        with self._lock:
+            self._items.append(item)
+            self.total += 1
+
+    def racy_put(self, item):
+        self._items.append(item)  # expect: GL03
+        self.total += 1  # expect: GL03
+
+    def racy_index(self, k, v):
+        self._items[k] = v  # expect: GL03
+
+    def reviewed_put(self, item):
+        self._items.append(item)  # graftlint: disable=GL03
+
+    def close(self):
+        self.closed = True  # not lock-guarded anywhere: clean
+
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        while not self.closed:
+            if self._items:  # expect: GL03
+                with self._lock:
+                    self._items.pop()
+
+
+def bump_locked():
+    global _G
+    with _LOCK:
+        _G += 1
+
+
+def bump_racy():
+    global _G
+    _G += 1  # expect: GL03
+
+
+def count_hit():
+    COUNTS["hits"] += 1  # expect: GL03
+
+
+def cache_put(k, v):
+    _CACHE[k] = v  # expect: GL03
+
+
+def cache_evict(k):
+    _CACHE.pop(k, None)  # expect: GL03
+
+
+def cache_put_locked(k, v):
+    with _LOCK:
+        _CACHE[k] = v
+
+
+def local_shadow(k, v):
+    _CACHE = {}
+    _CACHE[k] = v  # shadows the module container: clean
+    return _CACHE
+
+
+def outer_with_nested_global():
+    def inner():
+        global _G
+        _G = 2  # expect: GL03
+
+    _G = 3  # a LOCAL of outer (no global decl here): clean
+    inner()
+    return _G

@@ -1,0 +1,49 @@
+"""GL04 fixtures: silent-failure hygiene — positive, suppressed, clean.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+"""
+
+
+def sign(payload):
+    try:
+        return payload.sign()
+    except:  # expect: GL04
+        return None
+
+
+def verify(sig):
+    try:
+        return sig.check()
+    except Exception:  # expect: GL04
+        pass
+
+
+def verify_base(sig):
+    try:
+        return sig.check()
+    except BaseException:  # expect: GL04
+        pass
+
+
+def verify_logged(sig, log):
+    try:
+        return sig.check()
+    except ValueError as e:
+        log.warn("bad signature", error=str(e))
+        return False
+
+
+def tolerated(sig):
+    try:
+        return sig.check()
+    except Exception:  # graftlint: disable=GL04
+        pass
+
+
+def counted(sig, stats):
+    try:
+        return sig.check()
+    except Exception:
+        stats["dropped"] += 1  # not silent: counted and surfaced
+        return False

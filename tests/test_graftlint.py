@@ -1,0 +1,216 @@
+"""graftlint tier-1 gate + linter self-tests.
+
+Pure-AST: none of these tests import jax or the linted modules, so the
+whole file runs in a few seconds and belongs in tier-1.  Three layers:
+
+1. fixture files under tests/fixtures/graftlint/ assert exact rule ids
+   and line numbers per rule family (positive + suppressed cases);
+2. baseline machinery (pinning, excess-is-new, fixed detection) on a
+   dedicated pinned-cases fixture;
+3. THE GATE: harmony_tpu/ linted against the committed baseline — any
+   new finding fails tier-1 — plus the CLI exit-code contract.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint import (  # noqa: E402
+    DEFAULT_BASELINE_PATH,
+    REPO_ROOT,
+    Baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+)
+from tools.graftlint.engine import compare  # noqa: E402
+
+FIXTURES = REPO / "tests" / "fixtures" / "graftlint"
+_EXPECT_RE = re.compile(r"#\s*expect:\s*(GL\d{2}(?:\s*,\s*GL\d{2})*)")
+
+
+def _expected(path: Path) -> set:
+    out = set()
+    for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), start=1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            for rule in m.group(1).split(","):
+                out.add((lineno, rule.strip()))
+    return out
+
+
+@pytest.mark.parametrize("name", [
+    "gl01_cases.py", "gl02_cases.py", "gl03_cases.py", "gl04_cases.py",
+])
+def test_fixture_exact_lines(name):
+    """Each rule family flags exactly the tagged lines — no more, no
+    less — and inline suppressions (incl. wrong-rule ones) behave."""
+    path = FIXTURES / name
+    rel = path.relative_to(REPO_ROOT).as_posix()
+    findings = lint_source(path.read_text(encoding="utf-8"), rel)
+    actual = {(f.line, f.rule) for f in findings}
+    expected = _expected(path)
+    assert actual == expected, (
+        f"{name}: flagged {sorted(actual - expected)} unexpectedly, "
+        f"missed {sorted(expected - actual)}"
+    )
+
+
+def test_fixture_rules_scoped_inside_harmony_tpu():
+    """The same weak-where source that fires in a limb module is out of
+    scope elsewhere in harmony_tpu/ — scoping is path-based."""
+    src = "import jax.numpy as jnp\n\ndef f(x):\n    return jnp.where(x > 0, 1, 0)\n"
+    in_scope = lint_source(src, "harmony_tpu/ops/fp.py")
+    out_of_scope = lint_source(src, "harmony_tpu/consensus/quorum.py")
+    assert [(f.rule, f.line) for f in in_scope] == [("GL02", 4)]
+    assert out_of_scope == []
+
+
+PINNED_SRC = '''\
+def racy_one(sig):
+    try:
+        return sig.check()
+    except Exception:
+        pass
+
+
+def racy_two(sig):
+    try:
+        return sig.check()
+    except Exception:
+        pass
+'''
+
+
+def test_baseline_pins_and_flags_excess():
+    """Pinned findings stay quiet; the same fingerprint appearing MORE
+    often than pinned reports exactly the excess sites."""
+    rel = "tests/fixtures/graftlint/pinned_virtual.py"
+    findings = lint_source(PINNED_SRC, rel)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("GL04", 4), ("GL04", 11),
+    ]
+    # distinct contexts -> distinct fingerprints: pin both, gate clean
+    full = Baseline.from_findings(findings)
+    new, pinned, fixed = compare(findings, full)
+    assert new == [] and pinned == 2 and fixed == []
+
+    # same fingerprint twice, only one pinned -> the excess is NEW and
+    # it is the LATER line that is reported
+    dup_src = PINNED_SRC.replace("racy_two", "racy_one")
+    dup = lint_source(dup_src, rel)
+    assert len({f.fingerprint for f in dup}) == 1
+    half = Baseline({dup[0].fingerprint: 1})
+    new, pinned, fixed = compare(dup, half)
+    assert pinned == 1 and [f.line for f in new] == [11]
+
+    # a fixed finding is reported so the pin can be shrunk
+    new, pinned, fixed = compare([], full)
+    assert new == [] and pinned == 0 and len(fixed) == 2
+
+
+def test_repo_gate_clean_against_committed_baseline():
+    """THE tier-1 gate: no new violations in harmony_tpu/."""
+    result = lint_paths(["harmony_tpu"])
+    assert not result.errors, result.errors
+    baseline = load_baseline()
+    new, _pinned, fixed = compare(result.findings, baseline)
+    assert not new, (
+        "new graftlint violations (fix them, or pin deliberate debt "
+        "via `python -m tools.graftlint --write-baseline`):\n"
+        + "\n".join(f.render() for f in new)
+    )
+    assert not fixed, (
+        "baseline entries no longer fire — shrink the pin file with "
+        "`python -m tools.graftlint --write-baseline`:\n"
+        + "\n".join(fixed)
+    )
+
+
+def test_baseline_has_no_ops_gl01_gl02_pins():
+    """The ops/ hot path must be FIXED, never pinned, for purity and
+    dtype discipline (ISSUE 1 acceptance criterion)."""
+    baseline = load_baseline()
+    offenders = [
+        fp for fp in baseline.counts
+        if fp.startswith("harmony_tpu/ops/")
+        and ("::GL01::" in fp or "::GL02::" in fp)
+    ]
+    assert offenders == []
+
+
+def _run_cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exit_code_contract(tmp_path):
+    """0 clean, 1 new violations, 2 internal error — stable for hooks."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n", encoding="utf-8")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "def f(x):\n    try:\n        return x.check()\n"
+        "    except:\n        pass\n",
+        encoding="utf-8",
+    )
+    missing_baseline = tmp_path / "nothing.json"
+
+    r = _run_cli(str(clean), "--baseline", str(missing_baseline))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    r = _run_cli(str(dirty), "--baseline", str(missing_baseline))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GL04" in r.stdout
+
+    r = _run_cli(str(dirty), "--rules", "GL99")
+    assert r.returncode == 2, r.stdout + r.stderr
+
+    # --write-baseline pins the debt; the re-run gates clean on it
+    pin = tmp_path / "baseline.json"
+    r = _run_cli(str(dirty), "--baseline", str(pin), "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(pin.read_text(encoding="utf-8"))
+    assert sum(e["count"] for e in data["findings"]) == 1
+    r = _run_cli(str(dirty), "--baseline", str(pin))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # a narrowed run must not clobber the DEFAULT baseline's other pins
+    committed = DEFAULT_BASELINE_PATH.read_bytes()
+    r = _run_cli(str(dirty), "--write-baseline")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "refusing" in r.stderr
+    assert DEFAULT_BASELINE_PATH.read_bytes() == committed
+
+    # a syntactically broken file gates like a violation, not a crash
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n", encoding="utf-8")
+    r = _run_cli(str(broken), "--baseline", str(missing_baseline))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "SyntaxError" in r.stderr
+
+    # a typo'd path must fail loudly, not lint zero files and pass
+    r = _run_cli(str(tmp_path / "no_such_dir"),
+                 "--baseline", str(missing_baseline))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "not a .py file or directory" in r.stderr
+
+
+def test_default_baseline_is_committed_and_loads():
+    assert DEFAULT_BASELINE_PATH.exists()
+    baseline = load_baseline()
+    for fp, count in baseline.counts.items():
+        assert count >= 1
+        path = fp.split("::", 1)[0]
+        assert (REPO_ROOT / path).exists(), f"stale baseline path {path}"

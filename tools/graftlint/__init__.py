@@ -1,0 +1,46 @@
+"""graftlint: a JAX-aware static-analysis gate for harmony-tpu.
+
+The hot path of this repo moves Harmony's BLS12-381 verification onto
+JAX/XLA/Pallas, where three classic failure modes are invisible until
+they corrupt a signature or deadlock consensus:
+
+- Python side effects traced into ``@jax.jit`` (GL01): a ``time.time()``
+  or attribute mutation inside a traced function runs ONCE at trace
+  time and never again, silently freezing "dynamic" behavior into the
+  compiled program.
+- Weak-type promotion in limb arithmetic (GL02): an untyped literal or
+  ``jnp.asarray`` inside the 12-bit-limb uint32 math can promote a
+  whole accumulator chain to a different dtype and corrupt carries.
+- Unguarded shared state across the node's threading call sites (GL03):
+  state mutated under a lock in one method and written lock-free in
+  another is a data race that only shows up under consensus load.
+- Silent failure hygiene (GL04): a bare ``except:`` (or
+  ``except Exception: pass``) in a consensus or crypto path turns a
+  signature bug into an undiagnosable liveness stall.
+
+Usage (CLI)::
+
+    python -m tools.graftlint [paths...]          # gate vs baseline
+    python -m tools.graftlint --write-baseline    # regenerate pins
+    python -m tools.graftlint --all               # ignore baseline
+
+Inline suppression: append ``# graftlint: disable=GL01`` (comma-
+separated rule ids, or ``all``) to the flagged line.
+
+Exit codes: 0 clean, 1 new violations, 2 internal error.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    Baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+    DEFAULT_BASELINE_PATH,
+    REPO_ROOT,
+    RULES,
+)
+
+__version__ = "1.0"

@@ -1,0 +1,124 @@
+"""CLI entry: ``python -m tools.graftlint [paths...]``.
+
+Exit-code contract (stable — pre-commit hooks and CI key off it):
+  0  clean: no findings beyond the committed baseline
+  1  new violations (or parse failures in linted files)
+  2  internal error in the linter itself
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import traceback
+from pathlib import Path
+
+from .engine import (
+    DEFAULT_BASELINE_PATH,
+    RULES,
+    Baseline,
+    compare,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static-analysis gate for harmony-tpu "
+                    "(rules: " + ", ".join(
+                        f"{k} {v}" for k, v in RULES.items()) + ")",
+    )
+    ap.add_argument("paths", nargs="*", default=["harmony_tpu"],
+                    help="files or directories to lint "
+                         "(default: harmony_tpu)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE_PATH),
+                    help="baseline JSON path")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--all", action="store_true",
+                    help="list every finding (pinned included), not just "
+                         "new ones; exit code still gates on NEW only")
+    ap.add_argument("--rules",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule finding counts")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary line")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    only = None
+    if args.rules:
+        only = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = only - set(RULES)
+        if unknown:
+            print(f"graftlint: unknown rules {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    result = lint_paths(args.paths, only)
+
+    if result.errors:
+        for err in result.errors:
+            print(f"graftlint: error: {err}", file=sys.stderr)
+        return 1  # unlintable source/paths gate the tree like a violation
+
+    if args.write_baseline:
+        # a narrowed run (path subset or --rules) sees only a slice of
+        # the findings; writing it to the DEFAULT baseline would silently
+        # drop every other pin and fail the next full gate
+        narrowed = only is not None or list(args.paths) != ["harmony_tpu"]
+        if narrowed and Path(args.baseline).resolve() == \
+                DEFAULT_BASELINE_PATH.resolve():
+            print("graftlint: refusing to overwrite the default baseline "
+                  "from a narrowed run (path subset or --rules); lint the "
+                  "full default scope, or pass an explicit --baseline "
+                  "path", file=sys.stderr)
+            return 2
+        baseline = Baseline.from_findings(result.findings)
+        write_baseline(baseline, args.baseline)
+        if not args.quiet:
+            per = dict(sorted(baseline.by_rule().items()))
+            print(f"graftlint: baseline written to {args.baseline} "
+                  f"({sum(baseline.counts.values())} findings: {per})")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new, pinned, fixed = compare(result.findings, baseline)
+
+    shown = result.findings if args.all else new
+    for f in shown:
+        tag = "" if f in new else "  [pinned]"
+        print(f.render() + tag)
+
+    if args.stats:
+        print("per-rule findings:", dict(sorted(
+            result.by_rule().items())))
+    if not args.quiet:
+        dt = time.monotonic() - t0
+        msg = (f"graftlint: {len(new)} new, {pinned} pinned, "
+               f"{len(fixed)} baseline entries now fixed "
+               f"({dt:.2f}s)")
+        if fixed:
+            msg += " — shrink the pin file with --write-baseline"
+        print(msg)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:
+        traceback.print_exc()
+        sys.exit(2)

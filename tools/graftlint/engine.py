@@ -1,0 +1,273 @@
+"""graftlint engine: file walking, rule scoping, suppressions, baseline.
+
+Scoping: each rule family applies to the slice of the tree where its
+failure mode lives (see ``_rule_applies``).  Files OUTSIDE
+``harmony_tpu/`` (fixtures, tools) get every rule — that is what the
+linter's own test fixtures rely on.
+
+Baseline: pre-existing findings are *pinned*, not hidden.  A finding's
+fingerprint is ``path::rule::context::message`` — no line numbers, so
+pins survive unrelated edits; the gate fails only when the count of a
+fingerprint exceeds its pinned count (a NEW violation) and reports the
+excess sites.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from . import rules as R
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+RULES = {
+    "GL01": "jit purity: no side effects / host syncs in traced code",
+    "GL02": "limb-dtype discipline: no weak-type promotion in limb math",
+    "GL03": "lock discipline: no unguarded access to lock-guarded state",
+    "GL04": "silent-failure hygiene: no blind excepts in crypto/consensus",
+}
+
+# -- rule scoping over harmony_tpu/ -----------------------------------------
+
+_GL02_FILES = {
+    "harmony_tpu/ops/limbs.py",
+    "harmony_tpu/ops/fp.py",
+    "harmony_tpu/ops/fp_pallas.py",
+    "harmony_tpu/ops/towers.py",
+}
+_GL03_PREFIXES = (
+    "harmony_tpu/node/", "harmony_tpu/p2p/", "harmony_tpu/consensus/",
+    "harmony_tpu/rpc/", "harmony_tpu/sync/",
+)
+_GL03_FILES = {"harmony_tpu/device.py", "harmony_tpu/metrics.py"}
+_GL04_PREFIXES = (
+    "harmony_tpu/consensus/", "harmony_tpu/node/", "harmony_tpu/chain/",
+    "harmony_tpu/ops/", "harmony_tpu/ref/",
+)
+_GL04_FILES = {
+    "harmony_tpu/bls.py", "harmony_tpu/multibls.py",
+    "harmony_tpu/crypto_bn256.py", "harmony_tpu/crypto_ecdsa.py",
+    "harmony_tpu/crypto_vrf.py", "harmony_tpu/crypto_vrf_p256.py",
+    "harmony_tpu/vdf.py", "harmony_tpu/vdf_wesolowski.py",
+    "harmony_tpu/keystore.py", "harmony_tpu/blsgen_kms.py",
+}
+
+
+def _rule_applies(rule: str, relpath: str) -> bool:
+    if not relpath.startswith("harmony_tpu/"):
+        return True  # fixtures / external files: all rules
+    if rule == "GL01":
+        return True
+    if rule == "GL02":
+        return relpath in _GL02_FILES
+    if rule == "GL03":
+        return (relpath in _GL03_FILES
+                or relpath.startswith(_GL03_PREFIXES))
+    if rule == "GL04":
+        return (relpath in _GL04_FILES
+                or relpath.startswith(_GL04_PREFIXES))
+    return False
+
+
+# -- findings ----------------------------------------------------------------
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+    context: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.context}::{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule} {self.message} [{self.context}]")
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)  # unparseable files
+
+    def by_rule(self) -> Counter:
+        return Counter(f.rule for f in self.findings)
+
+
+# -- suppressions ------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"graftlint:\s*disable=([^#]*)")
+_RULE_ID_RE = re.compile(r"\b(GL\d{2}|ALL)\b", re.IGNORECASE)
+
+
+def _suppressions(source: str) -> dict[int, set[str]]:
+    """line -> set of suppressed rule ids ('ALL' suppresses every rule).
+
+    Ids are extracted as tokens so a trailing justification is fine:
+    ``# graftlint: disable=GL03 caller holds the lock``."""
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                ids = {t.upper() for t in _RULE_ID_RE.findall(m.group(1))}
+                if ids:
+                    out.setdefault(tok.start[0], set()).update(ids)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the ast parse will report the real problem
+    return out
+
+
+def _suppressed(f: Finding, supp: dict[int, set[str]]) -> bool:
+    ids = supp.get(f.line)
+    return bool(ids) and (f.rule in ids or "ALL" in ids)
+
+
+# -- linting -----------------------------------------------------------------
+
+
+def lint_source(source: str, relpath: str,
+                only_rules: set[str] | None = None) -> list[Finding]:
+    """Lint one file's source.  relpath must be repo-relative posix."""
+    import ast
+
+    tree = ast.parse(source, filename=relpath)
+    supp = _suppressions(source)
+    findings: list[Finding] = []
+    for rule, check in R.ALL_RULES.items():
+        if only_rules is not None and rule not in only_rules:
+            continue
+        if not _rule_applies(rule, relpath):
+            continue
+        for raw in check(tree, relpath):
+            f = Finding(relpath, raw.line, raw.col, raw.rule,
+                        raw.message, raw.context)
+            if not _suppressed(f, supp):
+                findings.append(f)
+    return sorted(findings)
+
+
+def _iter_py_files(paths: list[str | Path]) -> tuple[list[Path], list[str]]:
+    """Resolve lint targets; unresolvable paths are returned as errors —
+    a typo'd path in a CI hook must fail loudly, not lint zero files."""
+    files: list[Path] = []
+    bad: list[str] = []
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute():
+            p = REPO_ROOT / p
+        if p.is_dir():
+            files.extend(sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            ))
+        elif p.is_file() and p.suffix == ".py":
+            files.append(p)
+        else:
+            bad.append(f"{p}: not a .py file or directory")
+    return files, bad
+
+
+def lint_paths(paths: list[str | Path],
+               only_rules: set[str] | None = None) -> LintResult:
+    result = LintResult()
+    files, bad = _iter_py_files(paths)
+    result.errors.extend(bad)
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(REPO_ROOT).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            source = f.read_text(encoding="utf-8")
+            result.findings.extend(lint_source(source, rel, only_rules))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+    result.findings.sort()
+    return result
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+@dataclass
+class Baseline:
+    counts: dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        return cls(dict(Counter(f.fingerprint for f in findings)))
+
+    def by_rule(self) -> Counter:
+        out: Counter = Counter()
+        for fp, n in self.counts.items():
+            out[fp.split("::")[1]] += n
+        return out
+
+
+def load_baseline(path: str | Path = DEFAULT_BASELINE_PATH) -> Baseline:
+    path = Path(path)
+    if not path.exists():
+        return Baseline()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return Baseline({
+        e["fingerprint"]: int(e["count"]) for e in data.get("findings", [])
+    })
+
+
+def write_baseline(baseline: Baseline,
+                   path: str | Path = DEFAULT_BASELINE_PATH) -> None:
+    data = {
+        "version": 1,
+        "tool": "graftlint",
+        "note": ("pinned pre-existing findings; regenerate with "
+                 "python -m tools.graftlint --write-baseline"),
+        "findings": [
+            {"fingerprint": fp, "count": n}
+            for fp, n in sorted(baseline.counts.items())
+        ],
+    }
+    Path(path).write_text(
+        json.dumps(data, indent=1) + "\n", encoding="utf-8"
+    )
+
+
+def compare(findings: list[Finding],
+            baseline: Baseline) -> tuple[list[Finding], int, list[str]]:
+    """Gate findings against the baseline.
+
+    Returns (new_findings, pinned_count, fixed_fingerprints): per
+    fingerprint, the first ``pinned`` occurrences (by line) are covered
+    by the baseline and any excess is NEW; baseline entries with no
+    remaining occurrences are FIXED (candidates for --write-baseline).
+    """
+    by_fp: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint, []).append(f)
+
+    new: list[Finding] = []
+    pinned = 0
+    for fp, fs in by_fp.items():
+        allowed = baseline.counts.get(fp, 0)
+        fs = sorted(fs)
+        pinned += min(allowed, len(fs))
+        new.extend(fs[allowed:])
+    fixed = [
+        fp for fp, n in baseline.counts.items()
+        if len(by_fp.get(fp, ())) < n
+    ]
+    return sorted(new), pinned, sorted(fixed)

@@ -1,0 +1,733 @@
+"""The four graftlint rule families, implemented over the stdlib AST.
+
+Each rule is a function ``(tree: ast.Module, relpath: str) -> list[RawFinding]``
+— pure syntax, no imports of the linted code, so the linter runs in
+milliseconds per file and can never be wedged by a broken module.
+
+Rule ids are stable API: baselines and inline suppressions refer to
+them.  Messages deliberately contain the offending *names* but never
+line numbers, so a finding's fingerprint survives unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RawFinding:
+    rule: str
+    line: int
+    col: int
+    message: str
+    context: str  # innermost enclosing function qualname, or "<module>"
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _qualnames(tree: ast.Module) -> dict[int, str]:
+    """Map id(def-node) -> dotted qualname for every function/class."""
+    out: dict[int, str] = {}
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                out[id(child)] = qn
+                walk(child, qn)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _enclosing_map(tree: ast.Module) -> dict[int, str]:
+    """Map id(any node) -> qualname of innermost enclosing function."""
+    qn = _qualnames(tree)
+    out: dict[int, str] = {}
+
+    def walk(node: ast.AST, ctx: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, qn[id(child)])
+            else:
+                out[id(child)] = ctx
+                walk(child, ctx)
+
+    walk(tree, "<module>")
+    return out
+
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ---------------------------------------------------------------------------
+# GL01 — jit purity
+
+# decorators that make a function traced
+_TRACE_DECOS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "jax.shard_map", "shard_map",
+    "pjit", "jax.experimental.pjit.pjit", "jax.vmap", "vmap",
+}
+_PARTIAL = {"functools.partial", "partial"}
+# call heads whose function-valued args become traced
+_TRACE_CALLERS = {
+    "jax.jit", "jax.pmap", "jax.shard_map", "jax.vmap", "pjit",
+    "pl.pallas_call", "pallas_call", "pltpu.pallas_call",
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch", "jax.lax.map", "lax.map",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "jax.checkpoint", "jax.remat",
+}
+
+_IMPURE_EXACT = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.now",
+    "os.urandom", "uuid.uuid4", "open", "input",
+}
+_IMPURE_PREFIXES = (
+    "random.", "np.random.", "numpy.random.", "secrets.",
+)
+_HOST_SYNC = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "jax.block_until_ready",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_trace_decorator(deco: ast.AST) -> bool:
+    d = dotted_name(deco)
+    if d in _TRACE_DECOS:
+        return True
+    if isinstance(deco, ast.Call):
+        head = dotted_name(deco.func)
+        if head in _TRACE_DECOS:
+            return True  # e.g. @jax.jit(donate_argnums=0) style
+        if head in _PARTIAL and deco.args:
+            return dotted_name(deco.args[0]) in _TRACE_DECOS
+    return False
+
+
+def _collect_traced_defs(tree: ast.Module) -> list[ast.AST]:
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: dict[int, ast.AST] = {}
+
+    def mark(fn: ast.AST):
+        if id(fn) in traced:
+            return
+        traced[id(fn)] = fn
+        for inner in ast.walk(fn):
+            if inner is not fn and isinstance(inner, _FuncDef):
+                traced.setdefault(id(inner), inner)
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncDef):
+            if any(_is_trace_decorator(d) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call):
+            if dotted_name(node.func) in _TRACE_CALLERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        for fn in defs_by_name.get(arg.id, ()):
+                            mark(fn)
+    return list(traced.values())
+
+
+def _local_bindings(fn: ast.AST) -> set[str]:
+    """Names bound inside this function (params + stores), shallow —
+    nested defs keep their own scope."""
+    names: set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncDef):
+                names.add(child.name)
+                continue
+            if isinstance(child, ast.Name) and isinstance(
+                    child.ctx, (ast.Store, ast.Del)):
+                names.add(child.id)
+            walk(child)
+
+    walk(fn)
+    return names
+
+
+def check_gl01(tree: ast.Module, relpath: str) -> list[RawFinding]:
+    enclosing = _enclosing_map(tree)
+    findings: set[RawFinding] = set()
+
+    def emit(node: ast.AST, message: str):
+        findings.add(RawFinding(
+            "GL01", node.lineno, node.col_offset, message,
+            enclosing.get(id(node), "<module>"),
+        ))
+
+    for fn in _collect_traced_defs(tree):
+        local = _local_bindings(fn)
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FuncDef) and child is not node:
+                    continue  # nested defs are traced roots themselves
+                check(child)
+                walk(child)
+
+        def check(node: ast.AST):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name == "print":
+                    emit(node, "print() in traced function")
+                elif name in _IMPURE_EXACT or (
+                        name and name.startswith(_IMPURE_PREFIXES)):
+                    emit(node, f"impure call {name}() in traced function")
+                elif name in _HOST_SYNC:
+                    emit(node, f"host sync {name}() in traced function")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _HOST_SYNC_METHODS
+                      and not node.args and not node.keywords):
+                    emit(node, f".{node.func.attr}() host sync in "
+                               "traced function")
+            elif isinstance(node, ast.Global):
+                emit(node, "global statement in traced function")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for leaf in _store_leaves(tgt):
+                        if isinstance(leaf, ast.Attribute):
+                            d = dotted_name(leaf) or leaf.attr
+                            emit(node, f"mutation of attribute {d} in "
+                                       "traced function")
+                        elif isinstance(leaf, ast.Subscript):
+                            base = dotted_name(leaf.value)
+                            if (isinstance(leaf.value, ast.Name)
+                                    and leaf.value.id not in local):
+                                emit(node, "subscript store to non-local "
+                                           f"{base!r} in traced function")
+                            elif isinstance(leaf.value, ast.Attribute):
+                                emit(node, "subscript store to attribute "
+                                           f"{base or '?'} in traced "
+                                           "function")
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        emit(node, "del of shared state in traced function")
+
+        walk(fn)
+    return sorted(findings, key=lambda f: (f.line, f.col, f.message))
+
+
+def _store_leaves(tgt: ast.AST):
+    """Flatten tuple/list targets to the stored leaves."""
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _store_leaves(elt)
+    else:
+        yield tgt
+
+
+# ---------------------------------------------------------------------------
+# GL02 — limb-dtype discipline
+
+_JNP_ARRAY = {"jnp.array", "jnp.asarray", "jax.numpy.array",
+              "jax.numpy.asarray"}
+_JNP_FACTORY = {"jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty",
+                "jnp.arange", "jax.numpy.zeros", "jax.numpy.ones",
+                "jax.numpy.full", "jax.numpy.empty", "jax.numpy.arange"}
+_JNP_WHERE = {"jnp.where", "jax.numpy.where"}
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literalish(e) for e in node.elts)
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_literalish(node.left) and _is_literalish(node.right)
+    return False
+
+
+def check_gl02(tree: ast.Module, relpath: str) -> list[RawFinding]:
+    enclosing = _enclosing_map(tree)
+    findings: list[RawFinding] = []
+
+    # calls immediately consumed by .astype(...) are dtype-disciplined
+    astype_wrapped: set[int] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"):
+            astype_wrapped.add(id(node.func.value))
+
+    def emit(node: ast.AST, message: str):
+        findings.append(RawFinding(
+            "GL02", node.lineno, node.col_offset, message,
+            enclosing.get(id(node), "<module>"),
+        ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            has_dtype = any(k.arg == "dtype" for k in node.keywords)
+            if name in _JNP_ARRAY and not has_dtype and node.args:
+                if _is_literalish(node.args[0]):
+                    emit(node, f"untyped {name}() over Python literals "
+                               "(weak dtype promotes in limb math)")
+            elif name in _JNP_FACTORY and not has_dtype:
+                emit(node, f"{name}() without explicit dtype "
+                           "(defaults leak into limb math)")
+            elif (name in _JNP_WHERE and len(node.args) == 3
+                  and id(node) not in astype_wrapped):
+                if any(isinstance(a, ast.Constant)
+                       and isinstance(a.value, (int, float))
+                       and not isinstance(a.value, bool)
+                       for a in node.args[1:3]):
+                    emit(node, "weak-typed numeric literal in jnp.where "
+                               "(add .astype(...) or a typed constant)")
+        elif (isinstance(node, ast.Constant)
+              and isinstance(node.value, float)):
+            emit(node, f"float literal {node.value!r} in integer limb "
+                       "module")
+    return sorted(findings, key=lambda f: (f.line, f.col, f.message))
+
+
+# ---------------------------------------------------------------------------
+# GL03 — lock discipline
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore")
+_MUTATORS = {
+    "append", "appendleft", "add", "remove", "discard", "pop", "popleft",
+    "popitem", "clear", "update", "extend", "insert", "setdefault",
+}
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return bool(d) and d.split(".")[-1] in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for ``self.x`` nodes, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def check_gl03(tree: ast.Module, relpath: str) -> list[RawFinding]:
+    enclosing = _enclosing_map(tree)
+    findings: list[RawFinding] = []
+
+    def emit(node: ast.AST, message: str):
+        findings.append(RawFinding(
+            "GL03", node.lineno, node.col_offset, message,
+            enclosing.get(id(node), "<module>"),
+        ))
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        _check_class(cls, emit)
+    _check_module_globals(tree, emit)
+    _check_module_containers(tree, emit)
+    return sorted(findings, key=lambda f: (f.line, f.col, f.message))
+
+
+def _with_lock_items(node: ast.With, lock_attrs: set[str]) -> bool:
+    for item in node.items:
+        a = _self_attr(item.context_expr)
+        if a in lock_attrs:
+            return True
+    return False
+
+
+def _check_class(cls: ast.ClassDef, emit):
+    methods = [n for n in cls.body if isinstance(n, _FuncDef)]
+
+    # 1. lock attributes: self.X = threading.Lock()/RLock()/Condition()
+    lock_attrs: set[str] = set()
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a:
+                        lock_attrs.add(a)
+    if not lock_attrs:
+        return
+
+    # 2. guarded attrs: every self.Y *written or mutated* lexically
+    #    under a ``with self.<lock>:`` anywhere in the class.  Reads
+    #    under a lock are deliberately NOT enough to mark an attribute
+    #    guarded — incidental reads inside a critical section (method
+    #    calls, internally-synchronized members) would drown the signal.
+    guarded: dict[str, str] = {}  # attr -> lock attr that guards it
+
+    def note_guarded(child: ast.AST, lock: str):
+        if isinstance(child, (ast.Assign, ast.AugAssign)):
+            targets = (child.targets if isinstance(child, ast.Assign)
+                       else [child.target])
+            for tgt in targets:
+                for leaf in _store_leaves(tgt):
+                    a = _self_attr(leaf)
+                    if a is None and isinstance(leaf, ast.Subscript):
+                        a = _self_attr(leaf.value)
+                    if a and a not in lock_attrs:
+                        guarded.setdefault(a, lock)
+        elif (isinstance(child, ast.Call)
+              and isinstance(child.func, ast.Attribute)
+              and child.func.attr in _MUTATORS):
+            a = _self_attr(child.func.value)
+            if a and a not in lock_attrs:
+                guarded.setdefault(a, lock)
+
+    def scan_guarded(node: ast.AST, lock: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                hit = None
+                for item in child.items:
+                    a = _self_attr(item.context_expr)
+                    if a in lock_attrs:
+                        hit = a
+                scan_guarded(child, hit or lock)
+                continue
+            if lock is not None:
+                note_guarded(child, lock)
+            scan_guarded(child, lock)
+
+    for m in methods:
+        scan_guarded(m, None)
+    if not guarded:
+        return
+
+    # 3. thread targets: methods handed to threading.Thread(target=...)
+    thread_targets: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d and d.split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        a = _self_attr(kw.value)
+                        if a:
+                            thread_targets.add(a)
+
+    # 4. flag unguarded writes (and reads inside thread targets)
+    flagged: set[int] = set()
+
+    def scan_unguarded(node: ast.AST, in_lock: bool, is_target: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                scan_unguarded(
+                    child,
+                    in_lock or _with_lock_items(child, lock_attrs),
+                    is_target,
+                )
+                continue
+            if isinstance(child, _FuncDef):
+                # nested def (e.g. a thread body defined inline): its
+                # execution context is unknown — treat as outside lock
+                scan_unguarded(child, False, is_target)
+                continue
+            if not in_lock:
+                _flag_unguarded(child, guarded, is_target, emit, flagged)
+            scan_unguarded(child, in_lock, is_target)
+
+    for m in methods:
+        if m.name in _CTOR_NAMES:
+            continue
+        scan_unguarded(m, False, m.name in thread_targets)
+
+
+def _flag_unguarded(node: ast.AST, guarded: dict[str, str],
+                    is_target: bool, emit, flagged: set[int]):
+    if id(node) in flagged:
+        return
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            for leaf in _store_leaves(tgt):
+                a = _self_attr(leaf)
+                if a in guarded:
+                    flagged.add(id(node))
+                    emit(node, f"write to self.{a} outside "
+                               f"self.{guarded[a]} (lock-guarded "
+                               "elsewhere)")
+                elif (isinstance(leaf, ast.Subscript)):
+                    a = _self_attr(leaf.value)
+                    if a in guarded:
+                        flagged.add(id(node))
+                        emit(node, f"subscript store to self.{a}[...] "
+                                   f"outside self.{guarded[a]} "
+                                   "(lock-guarded elsewhere)")
+    elif isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            a = _self_attr(node.func.value)
+            if a in guarded and node.func.attr in _MUTATORS:
+                flagged.add(id(node))
+                emit(node, f"mutating call self.{a}.{node.func.attr}() "
+                           f"outside self.{guarded[a]} (lock-guarded "
+                           "elsewhere)")
+    elif (is_target and isinstance(node, ast.Attribute)
+          and isinstance(node.ctx, ast.Load)):
+        a = _self_attr(node)
+        if a in guarded:
+            flagged.add(id(node))
+            emit(node, f"read of lock-guarded self.{a} in thread target "
+                       f"without self.{guarded[a]}")
+
+
+def _check_module_globals(tree: ast.Module, emit):
+    # module-level lock names: _LOCK = threading.Lock()
+    locks: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    locks.add(tgt.id)
+    if not locks:
+        return
+
+    def with_has_lock(node: ast.With) -> str | None:
+        for item in node.items:
+            if (isinstance(item.context_expr, ast.Name)
+                    and item.context_expr.id in locks):
+                return item.context_expr.id
+        return None
+
+    # globals written under a module lock anywhere
+    guarded: dict[str, str] = {}
+
+    def scan(node: ast.AST, lock: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                scan(child, with_has_lock(child) or lock)
+                continue
+            if (lock is not None and isinstance(child, ast.Name)
+                    and isinstance(child.ctx, ast.Store)):
+                guarded.setdefault(child.id, lock)
+            scan(child, lock)
+
+    for node in tree.body:
+        if isinstance(node, _FuncDef):
+            scan(node, None)
+    if not guarded:
+        return
+
+    # writes to guarded globals outside any with-lock, in functions that
+    # DECLARE them global (module-level init assignments are fine).
+    # Each function is visited standalone (ast.walk below), so nested
+    # defs are skipped here — their `global` declarations must not leak
+    # into the enclosing scope, where the same name may be a local.
+    def scan_out(node: ast.AST, in_lock: bool, global_names: set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                scan_out(child,
+                         in_lock or with_has_lock(child) is not None,
+                         global_names)
+                continue
+            if isinstance(child, _FuncDef):
+                continue  # own scope; visited via ast.walk below
+            if (not in_lock and isinstance(child, ast.Name)
+                    and isinstance(child.ctx, ast.Store)
+                    and child.id in guarded
+                    and child.id in global_names):
+                emit(child, f"write to module global {child.id} outside "
+                            f"{guarded[child.id]} (lock-guarded "
+                            "elsewhere)")
+            scan_out(child, in_lock, global_names)
+
+    def own_globals(fn: ast.AST) -> set[str]:
+        """`global` names declared in this function body, nested defs
+        excluded (they are their own scope)."""
+        names: set[str] = set()
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FuncDef):
+                    continue
+                if isinstance(child, ast.Global):
+                    names.update(child.names)
+                walk(child)
+
+        walk(fn)
+        return names
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncDef):
+            scan_out(node, False, own_globals(node))
+
+
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "OrderedDict", "collections.OrderedDict",
+    "defaultdict", "collections.defaultdict", "deque",
+    "collections.deque", "Counter", "collections.Counter",
+}
+
+
+def _imports_threading(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "threading"
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "threading":
+                return True
+    return False
+
+
+def _check_module_containers(tree: ast.Module, emit):
+    """Shared module-level dict/list/set mutated inside functions with
+    no lock held at all — the ``COUNTERS[...] += 1`` class of race.
+    Only fires in modules that use threading (otherwise there is no
+    concurrency to race with)."""
+    if not _imports_threading(tree):
+        return
+
+    locks: set[str] = set()
+    containers: set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if _is_lock_ctor(value):
+            locks.update(t.id for t in targets if isinstance(t, ast.Name))
+        elif (isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                                 ast.ListComp, ast.SetComp))
+              or (isinstance(value, ast.Call)
+                  and dotted_name(value.func) in _CONTAINER_CTORS)):
+            containers.update(
+                t.id for t in targets if isinstance(t, ast.Name)
+            )
+    if not containers:
+        return
+
+    def with_has_lock(node: ast.With) -> bool:
+        return any(
+            isinstance(i.context_expr, ast.Name)
+            and i.context_expr.id in locks
+            for i in node.items
+        )
+
+    def base_container(node: ast.AST, local: set[str]) -> str | None:
+        if (isinstance(node, ast.Name) and node.id in containers
+                and node.id not in local):
+            return node.id
+        return None
+
+    def scan(node: ast.AST, in_lock: bool, local: set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                scan(child, in_lock or with_has_lock(child), local)
+                continue
+            if isinstance(child, _FuncDef):
+                continue  # visited on its own via ast.walk below
+            if not in_lock:
+                if isinstance(child, ast.AugAssign) and isinstance(
+                        child.target, ast.Subscript):
+                    name = base_container(child.target.value, local)
+                    if name:
+                        emit(child, "non-atomic augmented write to "
+                                    f"shared module container {name}[...]"
+                                    " without a lock")
+                elif isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        for leaf in _store_leaves(tgt):
+                            if isinstance(leaf, ast.Subscript):
+                                name = base_container(leaf.value, local)
+                                if name:
+                                    emit(child, "write to shared module "
+                                                f"container {name}[...] "
+                                                "without a lock")
+                elif (isinstance(child, ast.Call)
+                      and isinstance(child.func, ast.Attribute)
+                      and child.func.attr in _MUTATORS):
+                    name = base_container(child.func.value, local)
+                    if name:
+                        emit(child, "mutating call "
+                                    f"{name}.{child.func.attr}() without "
+                                    "a lock")
+            scan(child, in_lock, local)
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncDef):
+            scan(node, False, _local_bindings(node))
+
+
+# ---------------------------------------------------------------------------
+# GL04 — silent-failure hygiene
+
+
+def check_gl04(tree: ast.Module, relpath: str) -> list[RawFinding]:
+    enclosing = _enclosing_map(tree)
+    findings: list[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(RawFinding(
+                "GL04", node.lineno, node.col_offset,
+                "bare except: swallows everything incl. KeyboardInterrupt"
+                " (use a typed except + log)",
+                enclosing.get(id(node), "<module>"),
+            ))
+        elif (dotted_name(node.type) in ("Exception", "BaseException")
+              and all(isinstance(s, ast.Pass) for s in node.body)):
+            findings.append(RawFinding(
+                "GL04", node.lineno, node.col_offset,
+                f"except {dotted_name(node.type)}: pass silences failures"
+                " (use a typed except + log)",
+                enclosing.get(id(node), "<module>"),
+            ))
+    return sorted(findings, key=lambda f: (f.line, f.col, f.message))
+
+
+ALL_RULES = {
+    "GL01": check_gl01,
+    "GL02": check_gl02,
+    "GL03": check_gl03,
+    "GL04": check_gl04,
+}
