@@ -81,17 +81,27 @@ class EpochChain:
             raise EpochChainError(
                 "not an epoch block: no shard state carried"
             )
+        head = self.head_epoch()
+        if head is not None and header.epoch <= head:
+            return  # idempotent: already followed through here
+        # seal verification is the expensive step — pairing programs, a
+        # device dispatch, possibly a sidecar RPC over a socket — and it
+        # needs nothing this lock guards, so it runs BEFORE acquisition
+        # (GL05/GL06: holding the epoch-chain lock across it stalled
+        # every concurrent follower and nested the device/native locks
+        # under ours).  The head re-check under the lock keeps inserts
+        # idempotent when two threads verify the same epoch.
+        if self.engine is not None:
+            if not self.engine.verify_header_signature(
+                header, sig_bytes, bitmap
+            ):
+                raise EpochChainError(
+                    f"bad committee seal on epoch block {header.epoch}"
+                )
         with self._lock:
             head = self.head_epoch()
             if head is not None and header.epoch <= head:
-                return  # idempotent: already followed through here
-            if self.engine is not None:
-                if not self.engine.verify_header_signature(
-                    header, sig_bytes, bitmap
-                ):
-                    raise EpochChainError(
-                        f"bad committee seal on epoch block {header.epoch}"
-                    )
+                return
             rawdb.write_shard_state(self.db, header.epoch + 1, shard_state)
             self.db.put(
                 self._HEADER + header.epoch.to_bytes(8, "little"),

@@ -107,11 +107,20 @@ class TxPool:
 
     # -- admission ---------------------------------------------------------
 
-    def _validate(self, tx, is_staking: bool) -> bytes:
+    def _recover_sender(self, tx) -> bytes:
+        """Signature recovery — the expensive, pure-CPU part of
+        admission.  Callers hoist it OUT of the pool lock so gossip
+        ingest and RPC submits don't serialize behind each other's
+        ECDSA work."""
         try:
-            sender = tx.sender(self.chain_id)
+            return tx.sender(self.chain_id)
         except ValueError as e:
             raise PoolError(f"bad signature: {e}") from e
+
+    def _validate(self, tx, is_staking: bool,
+                  sender: bytes | None = None) -> bytes:
+        if sender is None:
+            sender = self._recover_sender(tx)
         if tx.shard_id != self.shard_id:
             raise PoolError("wrong shard")
         state = self._state_view()
@@ -151,9 +160,10 @@ class TxPool:
         self.evicted += 1
         return True
 
-    def _add_unlocked(self, tx, is_staking: bool = False) -> bytes:
+    def _add_unlocked(self, tx, is_staking: bool = False,
+                      sender: bytes | None = None) -> bytes:
         """Admit a tx; returns the recovered sender. Raises PoolError."""
-        sender = self._validate(tx, is_staking)
+        sender = self._validate(tx, is_staking, sender)
         state = self._state_view()
         slots = self._by_sender.setdefault(sender, {})
         old = slots.get(tx.nonce)
@@ -279,8 +289,11 @@ class TxPool:
 
     def add(self, tx, is_staking: bool = False,
             local: bool = False) -> bytes:
+        # recover the signature BEFORE taking the lock: it is the
+        # dominant cost of admission and needs no pool state
+        sender = self._recover_sender(tx)
         with self._lock:
-            sender = self._add_unlocked(tx, is_staking)
+            sender = self._add_unlocked(tx, is_staking, sender)
             if local:
                 entry = self._by_sender[sender][tx.nonce]
                 entry.local = True

@@ -18,11 +18,13 @@ from __future__ import annotations
 
 import threading
 
+from .metrics import LockedCounters
+
 _FORCED: bool | None = None
 _AUTO: bool | None = None
 _LOCK = threading.Lock()
 
-COUNTERS = {"verify": 0, "agg_verify": 0, "batch_verify": 0}
+COUNTERS = LockedCounters("verify", "agg_verify", "batch_verify")
 
 # Committee tables are padded to one of these pinned sizes so every
 # epoch/committee shares a small set of compiled programs (pad keys are
@@ -137,9 +139,16 @@ def device_enabled() -> bool:
     if _FORCED is not None:
         return _FORCED
     if _AUTO is None:
+        # probe OUTSIDE _LOCK: it joins a worker thread for up to
+        # DEVICE_PROBE_S seconds, and the consensus/insert paths reach
+        # this under their own locks — holding _LOCK across the join
+        # would stall every caller behind one wedged probe (GL06).
+        # Racing probes are idempotent; first answer under the lock
+        # wins and the others confirm it.
+        probed = _probe_backend()
         with _LOCK:
             if _AUTO is None:
-                _AUTO = _probe_backend()
+                _AUTO = probed
     return _AUTO
 
 
@@ -282,7 +291,7 @@ def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
 
         asarray = jnp.asarray
     h = hash_to_g2(payload)
-    COUNTERS["agg_verify"] += 1
+    COUNTERS.inc("agg_verify")
     fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
     ok = fn(
         table.device_array(),
@@ -335,6 +344,11 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
     widest = batch_buckets()[-1]
     fn = _get_agg_verify_batch_fn() if _fused() else OB.agg_verify_batch
     tbl = table.device_array()
+    # dispatch EVERY chunk before syncing ANY result: a per-chunk
+    # np.asarray inside this loop forced a device round-trip between
+    # programs, serializing the replay pipeline exactly where the
+    # batched verification should stream (GL07)
+    pending = []  # (ok device array, live lane count)
     for start in range(0, len(bits_list), widest):
         chunk_bits = bits_list[start:start + widest]
         chunk_h = h_points[start:start + widest]
@@ -345,8 +359,11 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
         hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
         sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
         ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
-        COUNTERS["batch_verify"] += 1
-        results.extend(bool(x) for x in np.asarray(ok)[:n])
+        COUNTERS.inc("batch_verify")
+        pending.append((ok, n))
+    for ok, n in pending:
+        # all programs are in flight; this loop only drains results
+        results.extend(bool(x) for x in np.asarray(ok)[:n])  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
     return results
 
 
@@ -383,5 +400,5 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
     sg = np.asarray(I.g2_batch_affine([sig_point] * width))
     fn = _get_verify_fn() if _fused() else OB.verify
     ok = fn(asarray(pk), asarray(hh), asarray(sg))
-    COUNTERS["verify"] += 1
+    COUNTERS.inc("verify")
     return bool(np.asarray(ok)[0])

@@ -14,6 +14,45 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+class LockedCounters:
+    """Named monotonic counters behind one lock, with a read-only
+    dict-like surface (``x["verify"]``, ``dict(x)``, ``.items()``).
+
+    Replaces the bare ``COUNTERS[kind] += 1`` module dict in
+    device.py: that read-modify-write raced the consensus, view-change
+    and replay threads and lived as three pinned GL03 findings.  One
+    uncontended lock per *signature check* (not per signature) is
+    noise against the pairing work it counts."""
+
+    def __init__(self, *names: str):
+        self._lock = threading.Lock()
+        self._v: dict[str, int] = {n: 0 for n in names}
+
+    def inc(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._v[name] = self._v.get(name, 0) + amount
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._v.get(name, 0)
+
+    def __setitem__(self, name: str, value: int) -> None:
+        # tests pin counters to known values around a scenario
+        with self._lock:
+            self._v[name] = int(value)
+
+    def keys(self):
+        with self._lock:
+            return list(self._v)
+
+    def items(self):
+        with self._lock:
+            return sorted(self._v.items())
+
+    def __iter__(self):
+        return iter(self.keys())
+
+
 def _fmt_labels(labels: dict) -> str:
     if not labels:
         return ""

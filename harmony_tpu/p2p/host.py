@@ -124,6 +124,17 @@ class _MsgCache:
             return [mid for mid, (t, _, at) in self._d.items()
                     if t == topic and at >= cutoff]
 
+    def recent_topics(self, window: float = 6.0) -> list:
+        """Topics with messages inside the gossip window — includes
+        topics this host only PUBLISHES to (gossipsub's fanout): a
+        publisher that is not itself subscribed must still advertise
+        ids, or a message published before the peer's SUBS announcement
+        lands is lost forever."""
+        cutoff = time.monotonic() - window
+        with self._lock:
+            return sorted({t for (t, _, at) in self._d.values()
+                           if at >= cutoff})
+
 
 class Host:
     """Common topic/validator bookkeeping for both transports."""
@@ -250,6 +261,9 @@ class TCPHost(Host):
     GOSSIP_LAZY = 6          # IHAVE targets per topic per heartbeat
     HEARTBEAT_S = 1.0
     IWANT_MAX = 32           # served per IWANT frame (anti-amplification)
+    IHAVE_MAX = 120          # ids per IHAVE digest (fits the 4 KB frame
+    #                          cap; a burst bigger than one digest
+    #                          drains over successive heartbeats)
 
     def __init__(self, name: str = "", listen_port: int = 0,
                  gater: Gater | None = None,
@@ -659,8 +673,14 @@ class TCPHost(Host):
             if now - asked < 2.0:
                 continue  # an earlier IWANT is in flight
             if not self._seen.has(mid):
-                self._iwant_asked[mid] = now
                 want.append(mid)
+            if len(want) >= self.IWANT_MAX:
+                break  # the rest re-appears in the next digest
+        # only the ids actually REQUESTED get the in-flight stamp —
+        # stamping the overflow too would back it off for 2 s without
+        # any request in flight, stretching burst recovery
+        for mid in want:
+            self._iwant_asked[mid] = now
         if len(self._iwant_asked) > 4096:
             cutoff = now - 10.0
             self._iwant_asked = {
@@ -668,9 +688,7 @@ class TCPHost(Host):
             }
         if want:
             try:
-                self._send_frame(
-                    sock, _KIND_IWANT, b"".join(want[: self.IWANT_MAX])
-                )
+                self._send_frame(sock, _KIND_IWANT, b"".join(want))
             except OSError:
                 pass
 
@@ -703,11 +721,27 @@ class TCPHost(Host):
     def _heartbeat(self, random):
         """Mesh maintenance + lazy gossip (gossipsub heartbeat): keep
         every subscribed topic's mesh within [D_LO, D_HI], and send
-        IHAVE digests of recent messages to a few non-mesh peers."""
+        IHAVE digests of recent messages to a few non-mesh peers.
+
+        Digests cover subscribed topics AND fanout topics (recently
+        published, not subscribed): a proposer publishing into a topic
+        it does not consume must still heal peers that missed the eager
+        push — e.g. when the publish raced the peer's SUBS announcement
+        and the mesh view was still empty."""
         now = time.monotonic()
+        # snapshot subscriptions and the message cache BEFORE taking
+        # _peer_lock: topics() and recent_ids() take their own locks,
+        # and nesting them under _peer_lock put undeclared edges in the
+        # whole-program lock-order graph (GL05) for zero benefit — both
+        # reads are advisory for this round
+        subscribed = self.topics()
+        gossip_topics = sorted(
+            set(subscribed) | set(self._mcache.recent_topics())
+        )
+        recent = {t: self._mcache.recent_ids(t) for t in gossip_topics}
         grafts, prunes, gossip = [], [], []
         with self._peer_lock:
-            for topic in self.topics():
+            for topic in subscribed:
                 mesh = self._mesh.setdefault(topic, set())
                 mesh.intersection_update(self._peers)
                 cands = [
@@ -727,7 +761,8 @@ class TCPHost(Host):
                     for s in drop:
                         mesh.discard(s)
                     prunes += [(s, topic) for s in drop]
-                mids = self._mcache.recent_ids(topic)
+            for topic in gossip_topics:
+                mids = recent.get(topic) or []
                 if mids:
                     # IHAVE digests go to a random sample of ALL
                     # eligible peers — mesh members included, so a
@@ -739,7 +774,7 @@ class TCPHost(Host):
                     random.shuffle(targets)
                     t = topic.encode()
                     frame = (bytes([len(t)]) + t
-                             + b"".join(mids[-self.IWANT_MAX:]))
+                             + b"".join(mids[-self.IHAVE_MAX:]))
                     gossip += [
                         (s, frame) for s in targets[: self.GOSSIP_LAZY]
                     ]

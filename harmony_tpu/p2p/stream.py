@@ -208,6 +208,14 @@ class SyncServer:
             pass
 
 
+class _PendingReply:
+    __slots__ = ("event", "body")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.body: bytes | None = None
+
+
 class SyncClient:
     """One peer's sync stream (reference: sync/client.go).
 
@@ -215,7 +223,15 @@ class SyncClient:
     peers come up in arbitrary order (a localnet's node 0 boots before
     its neighbour's server exists) and restart across a node's
     lifetime; a sync peer being down is a per-call error for the
-    downloader's peer rotation, never a constructor crash."""
+    downloader's peer rotation, never a constructor crash.
+
+    Requests are PIPELINED: the protocol already matches responses by
+    req_id, so ``_call`` registers a pending slot, sends, and waits on
+    its own event while a shared reader thread demultiplexes replies.
+    The old design held ``_lock`` across the socket recv (GL06), which
+    serialized every concurrent downloader stage behind one in-flight
+    request for up to the 30 s timeout — and made ``close`` unable to
+    take the lock at all."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
                  timeout: float = 30.0):
@@ -223,43 +239,102 @@ class SyncClient:
         self._timeout = timeout
         self._sock: socket.socket | None = None
         self._next_id = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # connection + id + pending map
+        self._send_lock = threading.Lock()  # frame atomicity only
+        self._pending: dict[int, _PendingReply] = {}
 
-    def _connect(self):
-        # only called from _call, which already holds self._lock
-        if self._sock is None:
-            self._sock = socket.create_connection(  # graftlint: disable=GL03
-                self._addr, timeout=self._timeout
-            )
+    def _ensure_connected(self) -> socket.socket:
+        """Current socket, dialing lazily — the dial itself (a blocking
+        connect with a long timeout) runs with NO lock held; racing
+        dialers resolve by the loser closing its spare socket."""
+        with self._lock:
+            if self._sock is not None:
+                return self._sock
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._timeout)
+        # blocking mode from here: the reader thread recvs continuously
+        # and must survive idle periods; per-call deadlines are enforced
+        # by the waiter's event timeout, not the socket
+        sock.settimeout(None)
+        with self._lock:
+            if self._sock is None:
+                self._sock = sock
+                threading.Thread(
+                    target=self._read_loop, args=(sock,), daemon=True
+                ).start()
+                return sock
+            loser, sock = sock, self._sock
+        try:
+            loser.close()
+        except OSError:
+            pass
+        return sock
+
+    def _read_loop(self, sock):
+        """Demultiplex responses to their waiters by req_id."""
+        while True:
+            hdr = _recv_exact(sock, _HDR.size)
+            if hdr is None:
+                break
+            ln, kind, rid = _HDR.unpack(hdr)
+            body = _recv_exact(sock, ln)
+            if body is None:
+                break
+            if kind != _RESP:
+                continue
+            with self._lock:
+                slot = self._pending.get(rid)
+            if slot is not None:
+                slot.body = body
+                slot.event.set()
+        self._drop(sock)
+
+    def _drop(self, sock):
+        """Retire a dead socket and fail every waiter parked on it.
+        Only the CURRENT socket's death fails the pending map — a stale
+        reader unwinding after a redial must not kill the healthy
+        waiters already registered against the new connection."""
+        stale: list = []
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+                stale = list(self._pending.values())
+                self._pending.clear()
+        for slot in stale:
+            slot.event.set()  # body stays None -> waiter raises
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def _call(self, payload: bytes) -> bytes:
+        sock = self._ensure_connected()
         with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+            slot = _PendingReply()
+            self._pending[req_id] = slot
+        try:
             try:
-                self._connect()
-                self._next_id += 1
-                req_id = self._next_id
-                self._sock.sendall(
-                    _HDR.pack(len(payload), _REQ, req_id) + payload
-                )
-                while True:
-                    hdr = _recv_exact(self._sock, _HDR.size)
-                    if hdr is None:
-                        raise ConnectionError("sync stream closed")
-                    ln, kind, rid = _HDR.unpack(hdr)
-                    body = _recv_exact(self._sock, ln)
-                    if body is None:
-                        raise ConnectionError("sync stream closed")
-                    if kind == _RESP and rid == req_id:
-                        return body
-            except (OSError, ConnectionError):
-                # drop the wedged socket; the next call redials
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
+                # _send_lock only keeps concurrent frames from
+                # interleaving; the response wait below happens with NO
+                # lock held, so calls overlap on the wire
+                with self._send_lock:
+                    sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
+                        _HDR.pack(len(payload), _REQ, req_id) + payload
+                    )
+            except OSError:
+                self._drop(sock)
                 raise
+            if not slot.event.wait(self._timeout):
+                self._drop(sock)  # wedged peer: fail everyone, redial
+                raise ConnectionError("sync request timed out")
+            if slot.body is None:
+                raise ConnectionError("sync stream closed")
+            return slot.body
+        finally:
+            with self._lock:
+                self._pending.pop(req_id, None)
 
     def get_head(self) -> tuple[int, bytes]:
         resp = self._call(bytes([METHOD_HEAD]))
@@ -332,15 +407,13 @@ class SyncClient:
         return rawdb.decode_shard_state(resp)
 
     def close(self):
-        # deliberately lock-free (a _call blocked in recv holds the
-        # lock for up to the timeout): closing the fd makes that recv
-        # raise OSError, whose handler owns the _sock=None cleanup
-        s = self._sock
+        # retire the socket NOW (null the slot, fail waiters, close the
+        # fd) rather than waiting for the reader thread to notice — the
+        # very next call must redial, not trip over a dead descriptor
+        with self._lock:
+            s = self._sock
         if s is not None:
-            try:
-                s.close()
-            except OSError:
-                pass
+            self._drop(s)
 
 
 def _recv_exact(sock, n: int) -> bytes | None:

@@ -153,12 +153,21 @@ class WSServer:
     def _serve_conn(self, sock):
         subs: dict[str, dict] = {}  # sub id -> {"kind", "last_block"}
         lock = threading.Lock()
+        # one writer at a time: the request loop and the pusher thread
+        # share this socket, and interleaved sendall calls would splice
+        # two WS frames together mid-header.  Held only around a single
+        # write_frame — never across dispatch or chain reads
+        wlock = threading.Lock()
         stop = threading.Event()
+
+        def write(payload: bytes, opcode: int = 0x1):
+            with wlock:
+                write_frame(sock, payload, opcode)
 
         def pusher():
             while not stop.is_set() and not self._closing:
                 try:
-                    self._push_round(sock, subs, lock)
+                    self._push_round(subs, lock, write)
                 except OSError:
                     return
                 stop.wait(self.poll_interval)
@@ -173,10 +182,10 @@ class WSServer:
                     return
                 opcode, payload = frame
                 if opcode == 0x8:  # close
-                    write_frame(sock, b"", 0x8)
+                    write(b"", 0x8)
                     return
                 if opcode == 0x9:  # ping
-                    write_frame(sock, payload, 0xA)
+                    write(payload, 0xA)
                     continue
                 if opcode != 0x1:
                     continue
@@ -185,7 +194,7 @@ class WSServer:
                 except ValueError:
                     continue
                 out = self._dispatch_ws(req, subs, lock)
-                write_frame(sock, json.dumps(out).encode())
+                write(json.dumps(out).encode())
         except OSError:
             pass
         finally:
@@ -233,7 +242,7 @@ class WSServer:
         pool = getattr(self.rpc.hmy, "tx_pool", None)
         return pool.add_seq if pool is not None else 0
 
-    def _push_round(self, sock, subs, lock):
+    def _push_round(self, subs, lock, write):
         with lock:
             items = list(subs.items())
         head = self.rpc.hmy.block_number()
@@ -244,7 +253,7 @@ class WSServer:
                     continue
                 sub["seq"], hashes = pool.adds_since(sub["seq"])
                 for h in hashes:
-                    self._notify(sock, sub_id, "0x" + h.hex())
+                    self._notify(write, sub_id, "0x" + h.hex())
                 continue
             since = sub["last_block"]
             if head <= since:
@@ -256,7 +265,7 @@ class WSServer:
                     if h is None:
                         continue
                     self._notify(
-                        sock, sub_id, self.rpc._header_dict(h, False)
+                        write, sub_id, self.rpc._header_dict(h, False)
                     )
             else:  # logs
                 crit = dict(sub["criteria"])
@@ -269,12 +278,13 @@ class WSServer:
                     max(frm, since + 1), to, address, topics
                 ):
                     self._notify(
-                        sock, sub_id,
+                        write, sub_id,
                         self.rpc._log_dict(*entry, False),
                     )
 
-    def _notify(self, sock, sub_id, result):
-        write_frame(sock, json.dumps({
+    @staticmethod
+    def _notify(write, sub_id, result):
+        write(json.dumps({
             "jsonrpc": "2.0",
             "method": "eth_subscription",
             "params": {"subscription": sub_id, "result": result},

@@ -1,0 +1,96 @@
+"""GL05 fixture: lock-order analysis.  tests/test_graftlint.py
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+Covers: a two-lock cycle (both edges flagged as cycles), an acyclic
+nested pair (flagged as an undeclared edge), a non-reentrant
+re-acquisition through a helper (self-deadlock), RLock re-acquisition
+(exempt), a cross-class edge through a uniquely-named method, and an
+inline suppression.
+"""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_X = threading.Lock()
+_Y = threading.Lock()
+_C = threading.Lock()
+_R = threading.RLock()
+
+
+def cycle_ab():
+    with _A:
+        with _B:  # expect: GL05
+            pass
+
+
+def cycle_ba():
+    with _B:
+        with _A:  # expect: GL05
+            pass
+
+
+def acyclic_edge():
+    with _X:
+        with _Y:  # expect: GL05
+            pass
+
+
+def _takes_c():
+    with _C:
+        pass
+
+
+def self_deadlock():
+    with _C:
+        _takes_c()  # expect: GL05
+
+
+def _takes_r():
+    with _R:
+        pass
+
+
+def reentrant_ok():
+    with _R:
+        _takes_r()  # RLock: same-thread re-acquisition is legal
+
+
+class Inner:
+    def __init__(self):
+        self._guard = threading.Lock()
+
+    def poke_inner_state(self):
+        with self._guard:
+            pass
+
+
+class Outer:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self.inner = Inner()
+
+    def touch(self):
+        with self._lk:
+            self.inner.poke_inner_state()  # expect: GL05
+
+
+def suppressed_edge():
+    with _X:
+        with _C:  # graftlint: disable=GL05 reviewed: X before C everywhere
+            pass
+
+
+_P = threading.Lock()
+_Q = threading.Lock()
+
+
+def multi_item_pq():
+    with _P, _Q:  # expect: GL05
+        pass
+
+
+def nested_qp():
+    with _Q:
+        with _P:  # expect: GL05
+            pass

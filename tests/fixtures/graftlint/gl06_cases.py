@@ -1,0 +1,64 @@
+"""GL06 fixture: blocking work under a held lock.  tests/test_graftlint.py
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+Covers: a direct sleep under a lock, a sleep reached through a helper
+call, socket I/O under a lock, a Thread.join under a lock, the clean
+patterns (blocking work outside the critical section), and an inline
+suppression.
+"""
+
+import socket
+import threading
+import time
+
+_L = threading.Lock()
+
+
+def sleepy_direct():
+    with _L:
+        time.sleep(1)  # expect: GL06
+
+
+def _nap():
+    time.sleep(1)
+
+
+def sleepy_via_call():
+    with _L:
+        _nap()  # expect: GL06
+
+
+def recv_under_lock(sock):
+    with _L:
+        sock.recv(4)  # expect: GL06
+
+
+def dial_under_lock(addr):
+    with _L:
+        return socket.create_connection(addr)  # expect: GL06
+
+
+def join_under_lock():
+    t = threading.Thread(target=_nap)
+    t.start()
+    with _L:
+        t.join()  # expect: GL06
+
+
+def clean_blocking_outside():
+    _nap()
+    with _L:
+        marker = 1
+    time.sleep(0)
+    return marker
+
+
+def clean_snapshot_then_send(sock):
+    with _L:
+        payload = b"x"
+    sock.sendall(payload)
+
+
+def suppressed_sleep():
+    with _L:
+        time.sleep(1)  # graftlint: disable=GL06 reviewed: bounded test-only wait

@@ -1,0 +1,66 @@
+"""GL07 fixture: hot-path host syncs.  tests/test_graftlint.py
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+Covers: a per-item device->host sync inside a loop, a loop calling a
+helper that syncs internally, the clean dispatch-all-then-drain
+pattern, host-side numpy in a loop (NOT a device sync), and an inline
+suppression.
+"""
+
+import jax
+import numpy as np
+
+
+def _kernel(x):
+    return x + 1
+
+
+def per_item_sync(items):
+    fn = jax.jit(_kernel)
+    out = []
+    for it in items:
+        ok = fn(it)
+        out.append(bool(np.asarray(ok)))  # expect: GL07
+    return out
+
+
+def _check_one(v):
+    fn = jax.jit(_kernel)
+    ok = fn(v)
+    return bool(np.asarray(ok))
+
+
+def loop_calls_syncer(items):
+    fn = jax.jit(_kernel)
+    first = fn(items[0])
+    out = [bool(np.asarray(first))]
+    for v in items[1:]:
+        out.append(_check_one(v))  # expect: GL07
+    return out
+
+
+def clean_dispatch_then_drain(items):
+    fn = jax.jit(_kernel)
+    pending = []
+    for it in items:
+        pending.append(fn(it))
+    stacked = np.asarray(pending)
+    return [bool(x) for x in stacked]
+
+
+def host_numpy_in_loop(rows):
+    fn = jax.jit(_kernel)
+    fn(rows[0])  # keep this function on the hot path
+    out = []
+    for r in rows:
+        out.append(np.asarray(r))  # host data prep: not a device sync
+    return out
+
+
+def suppressed_per_item(items):
+    fn = jax.jit(_kernel)
+    out = []
+    for it in items:
+        ok = fn(it)
+        out.append(bool(np.asarray(ok)))  # graftlint: disable=GL07 reviewed: tiny batches, latency beats batching
+    return out

@@ -50,6 +50,7 @@ def _expected(path: Path) -> set:
 
 @pytest.mark.parametrize("name", [
     "gl01_cases.py", "gl02_cases.py", "gl03_cases.py", "gl04_cases.py",
+    "gl05_cases.py", "gl06_cases.py", "gl07_cases.py",
 ])
 def test_fixture_exact_lines(name):
     """Each rule family flags exactly the tagged lines — no more, no
@@ -214,3 +215,198 @@ def test_default_baseline_is_committed_and_loads():
         assert count >= 1
         path = fp.split("::", 1)[0]
         assert (REPO_ROOT / path).exists(), f"stale baseline path {path}"
+
+
+# -- interprocedural pass (GL05-GL07) ---------------------------------------
+
+
+def test_cross_file_program_blocking_under_lock(tmp_path):
+    """The call graph crosses FILE boundaries: a.py holds its lock
+    while calling into b.py, whose helper sleeps — the finding lands in
+    a.py at the call site.  Linting a.py ALONE sees no finding (the
+    callee is outside the program), which is exactly the failure mode
+    the whole-program pass exists to close."""
+    (tmp_path / "b_helpers.py").write_text(
+        "import time\n\n\ndef drain():\n    time.sleep(1)\n",
+        encoding="utf-8",
+    )
+    (tmp_path / "a_caller.py").write_text(
+        "import threading\n\nfrom b_helpers import drain\n\n"
+        "_L = threading.Lock()\n\n\ndef tick():\n    with _L:\n"
+        "        drain()\n",
+        encoding="utf-8",
+    )
+    both = lint_paths([tmp_path])
+    assert not both.errors
+    gl06 = [f for f in both.findings if f.rule == "GL06"]
+    assert [(Path(f.path).name, f.line) for f in gl06] == \
+        [("a_caller.py", 10)]
+    assert "time.sleep" in gl06[0].message
+    alone = lint_paths([tmp_path / "a_caller.py"])
+    assert [f for f in alone.findings if f.rule == "GL06"] == []
+
+
+def test_lock_order_cycle_detected_across_classes(tmp_path):
+    """Opposite nesting of the same two locks in two classes is a
+    GL05 cycle; consistent nesting is only an (un-pinned) edge."""
+    (tmp_path / "deadlockable.py").write_text(
+        "import threading\n\n\n"
+        "class Consensus:\n"
+        "    def __init__(self):\n"
+        "        self._vc_lock = threading.Lock()\n"
+        "        self.net = Gossip()\n\n"
+        "    def view_change(self):\n"
+        "        with self._vc_lock:\n"
+        "            self.net.broadcast_view()\n\n\n"
+        "class Gossip:\n"
+        "    def __init__(self):\n"
+        "        self._mesh_lock = threading.Lock()\n"
+        "        self.fbft = None\n\n"
+        "    def broadcast_view(self):\n"
+        "        with self._mesh_lock:\n"
+        "            pass\n\n"
+        "    def on_message(self):\n"
+        "        with self._mesh_lock:\n"
+        "            self.fbft.start_view_change()\n\n\n"
+        "class FBFT:\n"
+        "    def start_view_change(self):\n"
+        "        with self.consensus._vc_lock:\n"
+        "            pass\n",
+        encoding="utf-8",
+    )
+    res = lint_paths([tmp_path / "deadlockable.py"])
+    cycles = [f for f in res.findings
+              if f.rule == "GL05" and "cycle" in f.message]
+    assert len(cycles) == 2, [f.render() for f in res.findings]
+    assert {f.context for f in cycles} == \
+        {"Consensus.view_change", "Gossip.on_message"}
+
+
+def test_sarif_output_validates_against_schema(tmp_path):
+    """--sarif emits SARIF 2.1.0 that validates against the minimal
+    schema (the subset GitHub/CI annotators require)."""
+    jsonschema = pytest.importorskip("jsonschema")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import threading\nimport time\n\n_L = threading.Lock()\n\n\n"
+        "def f():\n    with _L:\n        time.sleep(1)\n",
+        encoding="utf-8",
+    )
+    r = _run_cli(str(dirty), "--sarif",
+                 "--baseline", str(tmp_path / "none.json"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+
+    schema = {
+        "type": "object",
+        "required": ["version", "runs"],
+        "properties": {
+            "version": {"const": "2.1.0"},
+            "runs": {
+                "type": "array", "minItems": 1,
+                "items": {
+                    "type": "object",
+                    "required": ["tool", "results"],
+                    "properties": {
+                        "tool": {
+                            "type": "object",
+                            "required": ["driver"],
+                            "properties": {"driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {"type": "array"},
+                                },
+                            }},
+                        },
+                        "results": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "required": ["ruleId", "message",
+                                             "locations"],
+                                "properties": {
+                                    "ruleId": {"type": "string"},
+                                    "level": {"enum": [
+                                        "none", "note", "warning",
+                                        "error"]},
+                                    "message": {
+                                        "type": "object",
+                                        "required": ["text"],
+                                    },
+                                    "locations": {
+                                        "type": "array", "minItems": 1,
+                                        "items": {
+                                            "type": "object",
+                                            "required": [
+                                                "physicalLocation"],
+                                            "properties": {
+                                                "physicalLocation": {
+                                                    "type": "object",
+                                                    "required": [
+                                                        "artifactLocation"],
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    }
+    jsonschema.validate(doc, schema)
+    results = doc["runs"][0]["results"]
+    assert {r["ruleId"] for r in results} == {"GL06"}
+    region = results[0]["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 9
+    fps = results[0]["partialFingerprints"]
+    assert "::GL06::" in fps["graftlintFingerprint/v1"]
+
+
+_DOT_EDGE_RE = re.compile(r'^  "([^"]+)" -> "([^"]+)";$')
+
+
+def test_dot_output_is_parseable_callgraph():
+    r = _run_cli("tests/fixtures/graftlint/gl06_cases.py", "--dot")
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.splitlines()
+    assert lines[0] == "digraph graftlint_callgraph {"
+    assert lines[-1] == "}"
+    edges = set()
+    for line in lines[1:-1]:
+        m = _DOT_EDGE_RE.match(line)
+        assert m, f"unparseable DOT line: {line!r}"
+        edges.add((m.group(1), m.group(2)))
+    assert ("gl06_cases.py:sleepy_via_call",
+            "gl06_cases.py:_nap") in edges
+
+
+def test_whole_program_pass_is_fast():
+    """Acceptance: the full-repo whole-program pass runs in < 15 s on
+    CPU (measured ~4 s; the bound is generous for a loaded CI box)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    result = lint_paths(["harmony_tpu"])
+    dt = _time.monotonic() - t0
+    assert not result.errors
+    assert dt < 15.0, f"whole-program pass took {dt:.1f}s"
+
+
+def test_interproc_fingerprints_are_line_free_and_stable():
+    """GL05/GL06/GL07 fingerprints carry the lock pair / sync site,
+    never line numbers or witness chains — pins must survive unrelated
+    edits and witness rerouting."""
+    result = lint_paths(["harmony_tpu"])
+    inter = [f for f in result.findings
+             if f.rule in ("GL05", "GL06", "GL07")]
+    assert inter, "expected pinned interprocedural findings to exist"
+    for f in inter:
+        assert str(f.line) not in f.fingerprint.split("::", 2)[2], (
+            "line leaked into fingerprint", f.fingerprint)
+        if f.detail:
+            assert f.detail not in f.fingerprint

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Pre-commit gate for harmony-tpu.
+#
+# Two stages, fail-fast:
+#   1. graftlint — whole-program static analysis (GL01-GL07) against
+#      the committed baseline.  Exit-code contract (stable for hooks):
+#      0 clean, 1 new violations, 2 internal linter error — any
+#      non-zero stops this script with the same code.
+#   2. tier-1 smoke subset — the fast, pure-CPU slices that catch the
+#      classes of regression this repo's PRs most often introduce
+#      (linter self-tests, device-path wiring, thread-safety, codecs).
+#
+# Usage: tools/check.sh            (from anywhere; cd's to the repo)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint: whole-program gate vs committed baseline =="
+python -m tools.graftlint
+
+echo "== tier-1 smoke subset =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_graftlint.py \
+  tests/test_device_path.py \
+  tests/test_concurrency.py \
+  tests/test_rlp_trie.py \
+  tests/test_config.py
+
+echo "check.sh: OK"

@@ -48,9 +48,51 @@ def _parse_args(argv):
                          "(default: all)")
     ap.add_argument("--stats", action="store_true",
                     help="print per-rule finding counts")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit findings as SARIF 2.1.0 on stdout "
+                         "(new-vs-baseline only; exit code unchanged)")
+    ap.add_argument("--dot", action="store_true",
+                    help="emit the whole-program call graph as DOT on "
+                         "stdout and exit 0")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the summary line")
     return ap.parse_args(argv)
+
+
+def _sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 document (what CI annotators consume)."""
+    return {
+        "version": "2.1.0",
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://invalid.example/harmony-tpu/graftlint",
+                "rules": [
+                    {"id": rid, "shortDescription": {"text": desc}}
+                    for rid, desc in RULES.items()
+                ],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message + (
+                    f" (via {f.detail})" if f.detail else "")},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line,
+                                   "startColumn": f.col + 1},
+                    },
+                }],
+                "partialFingerprints": {
+                    "graftlintFingerprint/v1": f.fingerprint,
+                },
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -65,12 +107,19 @@ def main(argv=None) -> int:
             return 2
 
     t0 = time.monotonic()
-    result = lint_paths(args.paths, only)
+    program_out: list = [] if args.dot else None
+    result = lint_paths(args.paths, only, program_out=program_out)
 
     if result.errors:
         for err in result.errors:
             print(f"graftlint: error: {err}", file=sys.stderr)
         return 1  # unlintable source/paths gate the tree like a violation
+
+    if args.dot:
+        from .interproc import to_dot
+
+        sys.stdout.write(to_dot(program_out[0]))
+        return 0
 
     if args.write_baseline:
         # a narrowed run (path subset or --rules) sees only a slice of
@@ -94,6 +143,13 @@ def main(argv=None) -> int:
 
     baseline = load_baseline(args.baseline)
     new, pinned, fixed = compare(result.findings, baseline)
+
+    if args.sarif:
+        import json as _json
+
+        shown = result.findings if args.all else new
+        print(_json.dumps(_sarif(shown), indent=1))
+        return 1 if new else 0
 
     shown = result.findings if args.all else new
     for f in shown:

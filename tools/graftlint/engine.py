@@ -32,7 +32,11 @@ RULES = {
     "GL02": "limb-dtype discipline: no weak-type promotion in limb math",
     "GL03": "lock discipline: no unguarded access to lock-guarded state",
     "GL04": "silent-failure hygiene: no blind excepts in crypto/consensus",
+    "GL05": "lock order: no cycles in the whole-program lock graph",
+    "GL06": "no blocking I/O / joins / device work under a held lock",
+    "GL07": "hot path: no per-item device->host syncs in loops",
 }
+INTERPROC_RULES = {"GL05", "GL06", "GL07"}
 
 # -- rule scoping over harmony_tpu/ -----------------------------------------
 
@@ -73,6 +77,10 @@ def _rule_applies(rule: str, relpath: str) -> bool:
     if rule == "GL04":
         return (relpath in _GL04_FILES
                 or relpath.startswith(_GL04_PREFIXES))
+    if rule in INTERPROC_RULES:
+        # whole-program rules self-limit by semantics (locks held,
+        # hot-path reachability) — every module participates
+        return True
     return False
 
 
@@ -87,14 +95,21 @@ class Finding:
     rule: str
     message: str
     context: str
+    # free-form witness (e.g. a call chain) — rendered, NEVER part of
+    # the fingerprint: witness paths reroute when unrelated helpers
+    # change, and pins must survive that
+    detail: str = ""
 
     @property
     def fingerprint(self) -> str:
         return f"{self.path}::{self.rule}::{self.context}::{self.message}"
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col + 1}: "
-                f"{self.rule} {self.message} [{self.context}]")
+        out = (f"{self.path}:{self.line}:{self.col + 1}: "
+               f"{self.rule} {self.message} [{self.context}]")
+        if self.detail:
+            out += f"\n    via {self.detail}"
+        return out
 
 
 @dataclass
@@ -141,13 +156,8 @@ def _suppressed(f: Finding, supp: dict[int, set[str]]) -> bool:
 # -- linting -----------------------------------------------------------------
 
 
-def lint_source(source: str, relpath: str,
-                only_rules: set[str] | None = None) -> list[Finding]:
-    """Lint one file's source.  relpath must be repo-relative posix."""
-    import ast
-
-    tree = ast.parse(source, filename=relpath)
-    supp = _suppressions(source)
+def _intra_findings(tree, relpath: str, supp: dict,
+                    only_rules: set[str] | None) -> list[Finding]:
     findings: list[Finding] = []
     for rule, check in R.ALL_RULES.items():
         if only_rules is not None and rule not in only_rules:
@@ -159,6 +169,51 @@ def lint_source(source: str, relpath: str,
                         raw.message, raw.context)
             if not _suppressed(f, supp):
                 findings.append(f)
+    return findings
+
+
+def _interproc_findings(sources: dict, supps: dict,
+                        only_rules: set[str] | None,
+                        program_out: list | None = None) -> list[Finding]:
+    """Whole-program pass over {relpath: (source, tree)}."""
+    from . import interproc as IP
+
+    wanted = INTERPROC_RULES if only_rules is None \
+        else INTERPROC_RULES & only_rules
+    if not wanted and program_out is None:
+        return []
+    prog = IP.analyze(sources)
+    if program_out is not None:
+        program_out.append(prog)
+    raw: list = []
+    if "GL05" in wanted:
+        raw += IP.gl05_findings(prog)
+    if "GL06" in wanted:
+        raw += IP.gl06_findings(prog)
+    if "GL07" in wanted:
+        raw += IP.gl07_findings(prog)
+    findings = []
+    for sf in raw:
+        if not _rule_applies(sf.rule, sf.relpath):
+            continue
+        f = Finding(sf.relpath, sf.line, sf.col, sf.rule,
+                    sf.message, sf.context, sf.detail)
+        if not _suppressed(f, supps.get(sf.relpath, {})):
+            findings.append(f)
+    return findings
+
+
+def lint_source(source: str, relpath: str,
+                only_rules: set[str] | None = None) -> list[Finding]:
+    """Lint one file's source (the single-file program).  relpath must
+    be repo-relative posix."""
+    import ast
+
+    tree = ast.parse(source, filename=relpath)
+    supp = _suppressions(source)
+    findings = _intra_findings(tree, relpath, supp, only_rules)
+    findings += _interproc_findings(
+        {relpath: (source, tree)}, {relpath: supp}, only_rules)
     return sorted(findings)
 
 
@@ -183,10 +238,19 @@ def _iter_py_files(paths: list[str | Path]) -> tuple[list[Path], list[str]]:
 
 
 def lint_paths(paths: list[str | Path],
-               only_rules: set[str] | None = None) -> LintResult:
+               only_rules: set[str] | None = None,
+               program_out: list | None = None) -> LintResult:
+    """Lint files/dirs.  The union of resolved files is ONE program:
+    intra-file rules run per file, then the interprocedural pass (call
+    graph, GL05-GL07) runs across all of them together.  Pass a list as
+    ``program_out`` to receive the analyzed Program (for --dot)."""
+    import ast
+
     result = LintResult()
     files, bad = _iter_py_files(paths)
     result.errors.extend(bad)
+    sources: dict = {}
+    supps: dict = {}
     for f in files:
         try:
             rel = f.resolve().relative_to(REPO_ROOT).as_posix()
@@ -194,9 +258,16 @@ def lint_paths(paths: list[str | Path],
             rel = f.as_posix()
         try:
             source = f.read_text(encoding="utf-8")
-            result.findings.extend(lint_source(source, rel, only_rules))
+            tree = ast.parse(source, filename=rel)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+            continue
+        sources[rel] = (source, tree)
+        supps[rel] = _suppressions(source)
+        result.findings.extend(
+            _intra_findings(tree, rel, supps[rel], only_rules))
+    result.findings.extend(
+        _interproc_findings(sources, supps, only_rules, program_out))
     result.findings.sort()
     return result
 

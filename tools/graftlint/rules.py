@@ -1,4 +1,5 @@
-"""The four graftlint rule families, implemented over the stdlib AST.
+"""The per-file graftlint rule families (GL01-GL04), over the stdlib
+AST.  The interprocedural families (GL05-GL07) live in interproc.py.
 
 Each rule is a function ``(tree: ast.Module, relpath: str) -> list[RawFinding]``
 — pure syntax, no imports of the linted code, so the linter runs in
