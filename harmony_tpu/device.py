@@ -17,14 +17,75 @@ code in the shipped binary).
 from __future__ import annotations
 
 import threading
+import time
 
+from . import faultinject as FI
+from .log import get_logger
 from .metrics import LockedCounters
+from .resilience import CircuitBreaker
+
+_log = get_logger("device")
 
 _FORCED: bool | None = None
 _AUTO: bool | None = None
 _LOCK = threading.Lock()
 
-COUNTERS = LockedCounters("verify", "agg_verify", "batch_verify")
+COUNTERS = LockedCounters(
+    "verify", "agg_verify", "batch_verify", "ref_fallback"
+)
+
+# The device-dispatch circuit breaker: a backend that keeps raising (a
+# wedged accelerator tunnel, a dying sidecar of the twin kernels, an
+# injected chaos fault) trips it OPEN and every check routes straight
+# to the reference host path until a half-open probe re-admits the TPU.
+# Consensus keeps finalizing on the slow-but-correct path instead of
+# stalling — the fail-fast contract the FBFT layer assumes.
+BREAKER = CircuitBreaker("device", failure_threshold=5,
+                         reset_timeout_s=30.0)
+
+# Optional per-dispatch latency budget (seconds).  None disables the
+# check — the CPU test image legitimately takes seconds per eager
+# pairing, so only deployments (and chaos tests) arm it.  A dispatch
+# that completes but overruns the budget still returns its (correct)
+# result; it is counted as a breaker failure so a consistently slow
+# backend trips OPEN and later checks skip the wait entirely.
+DISPATCH_DEADLINE_S: float | None = None
+
+
+def set_dispatch_deadline(seconds: float | None) -> None:
+    global DISPATCH_DEADLINE_S
+    DISPATCH_DEADLINE_S = seconds
+
+
+def _guarded(kind: str, dispatch, fallback):
+    """Run one device dispatch under the breaker.
+
+    Raise -> breaker failure + reference fallback (transparent: the
+    caller still gets a correct bool).  Deadline overrun -> breaker
+    failure, device result kept.  Breaker OPEN -> fallback without
+    touching the device at all."""
+    if not BREAKER.allow():
+        COUNTERS.inc("ref_fallback")
+        return fallback()
+    t0 = time.monotonic()
+    try:
+        FI.fire("device.dispatch")
+        out = dispatch()
+    except Exception as e:  # noqa: BLE001 — any backend failure
+        # degrades to the host path, never up into consensus
+        BREAKER.record_failure()
+        COUNTERS.inc("ref_fallback")
+        _log.warn("device dispatch failed; reference fallback",
+                  kind=kind, error=str(e))
+        return fallback()
+    if (DISPATCH_DEADLINE_S is not None
+            and time.monotonic() - t0 > DISPATCH_DEADLINE_S):
+        BREAKER.record_failure()
+        _log.warn("device dispatch exceeded deadline", kind=kind,
+                  budget_s=DISPATCH_DEADLINE_S)
+    else:
+        BREAKER.record_success()
+    return out
 
 # Committee tables are padded to one of these pinned sizes so every
 # epoch/committee shares a small set of compiled programs (pad keys are
@@ -52,6 +113,10 @@ class CommitteeTable:
 
         self.n = len(points)
         self.size = committee_bucket(max(self.n, 1))
+        # the original reference points are kept (cheap: references
+        # only) so a failing backend can fall back to the host bigint
+        # path without re-deriving them from the device layout
+        self.points = list(points)
         arr = np.zeros((self.size, 2, 32), dtype=np.int32)
         if self.n:
             arr[: self.n] = I.g1_batch_affine(points)
@@ -270,36 +335,60 @@ def _fused() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def _ref_agg_verify(table: CommitteeTable, bits, h_point,
+                    sig_point) -> bool:
+    """Host bigint twin of the fused quorum check — the fallback when
+    the device backend is open-circuited or raised mid-dispatch."""
+    from .ref import bls as RB
+    from .ref.curve import g1
+
+    agg = None
+    for pt, bit in zip(table.points, bits):
+        if bit:
+            agg = g1.add(agg, pt)
+    if agg is None:
+        return False
+    return RB.verify_hashed(agg, h_point, sig_point)
+
+
 def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
                          sig_point) -> bool:
     """THE fused FBFT quorum check: committee table resident on device,
     bitmap in, bool out — masked G1 tree-sum AND the 2-pairing product
     with no host affine round-trip (reference semantics:
-    internal/chain/engine.go:619-642 in one shot)."""
-    import numpy as np
-
-    from .ops import interop as I
+    internal/chain/engine.go:619-642 in one shot).  Breaker-guarded:
+    a raising or open-circuited backend degrades transparently to the
+    reference host path."""
     from .ref.hash_to_curve import hash_to_g2
 
-    if kernel_twin_active():
-        asarray = np.asarray
-        OB = None  # twins only: jax stays unloaded
-    else:
-        import jax.numpy as jnp
-
-        from .ops import bls as OB
-
-        asarray = jnp.asarray
     h = hash_to_g2(payload)
     COUNTERS.inc("agg_verify")
-    fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
-    ok = fn(
-        table.device_array(),
-        asarray(table.pad_bits(bits)),
-        asarray(I.g2_affine_to_arr(h)),
-        asarray(I.g2_affine_to_arr(sig_point)),
-    )
-    return bool(np.asarray(ok))
+
+    def dispatch() -> bool:
+        import numpy as np
+
+        from .ops import interop as I
+
+        if kernel_twin_active():
+            asarray = np.asarray
+            OB = None  # twins only: jax stays unloaded
+        else:
+            import jax.numpy as jnp
+
+            from .ops import bls as OB
+
+            asarray = jnp.asarray
+        fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
+        ok = fn(
+            table.device_array(),
+            asarray(table.pad_bits(bits)),
+            asarray(I.g2_affine_to_arr(h)),
+            asarray(I.g2_affine_to_arr(sig_point)),
+        )
+        return bool(np.asarray(ok))
+
+    return _guarded("agg_verify", dispatch,
+                    lambda: _ref_agg_verify(table, bits, h, sig_point))
 
 
 # Pinned batch widths for the replay path (same rationale as the
@@ -326,45 +415,58 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
     """Replay-path batch: B quorum checks against one committee table,
     chunked to pinned batch widths — each chunk is ONE program (masked
     tree-sums + pairing checks together).  h_points are pre-hashed
-    payload points (host hash-to-G2); returns list[bool]."""
-    import numpy as np
+    payload points (host hash-to-G2); returns list[bool].  Breaker-
+    guarded like the single check: a backend failure anywhere in the
+    batch re-runs the whole window on the reference host path."""
 
-    from .ops import interop as I
+    def dispatch():
+        import numpy as np
 
-    if kernel_twin_active():
-        asarray = np.asarray
-        OB = None  # twins only: jax stays unloaded
-    else:
-        import jax.numpy as jnp
+        from .ops import interop as I
 
-        from .ops import bls as OB
+        if kernel_twin_active():
+            asarray = np.asarray
+            OB = None  # twins only: jax stays unloaded
+        else:
+            import jax.numpy as jnp
 
-        asarray = jnp.asarray
-    results = []
-    widest = batch_buckets()[-1]
-    fn = _get_agg_verify_batch_fn() if _fused() else OB.agg_verify_batch
-    tbl = table.device_array()
-    # dispatch EVERY chunk before syncing ANY result: a per-chunk
-    # np.asarray inside this loop forced a device round-trip between
-    # programs, serializing the replay pipeline exactly where the
-    # batched verification should stream (GL07)
-    pending = []  # (ok device array, live lane count)
-    for start in range(0, len(bits_list), widest):
-        chunk_bits = bits_list[start:start + widest]
-        chunk_h = h_points[start:start + widest]
-        chunk_s = sig_points[start:start + widest]
-        n, padded = len(chunk_bits), batch_bucket(len(chunk_bits))
-        sel = list(range(n)) + [0] * (padded - n)  # pad lanes sliced off
-        bm = np.stack([table.pad_bits(chunk_bits[i]) for i in sel])
-        hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
-        sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
-        ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
-        COUNTERS.inc("batch_verify")
-        pending.append((ok, n))
-    for ok, n in pending:
-        # all programs are in flight; this loop only drains results
-        results.extend(bool(x) for x in np.asarray(ok)[:n])  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
-    return results
+            from .ops import bls as OB
+
+            asarray = jnp.asarray
+        results = []
+        widest = batch_buckets()[-1]
+        fn = (_get_agg_verify_batch_fn() if _fused()
+              else OB.agg_verify_batch)
+        tbl = table.device_array()
+        # dispatch EVERY chunk before syncing ANY result: a per-chunk
+        # np.asarray inside this loop forced a device round-trip between
+        # programs, serializing the replay pipeline exactly where the
+        # batched verification should stream (GL07)
+        pending = []  # (ok device array, live lane count)
+        for start in range(0, len(bits_list), widest):
+            chunk_bits = bits_list[start:start + widest]
+            chunk_h = h_points[start:start + widest]
+            chunk_s = sig_points[start:start + widest]
+            n, padded = len(chunk_bits), batch_bucket(len(chunk_bits))
+            sel = list(range(n)) + [0] * (padded - n)  # pad lanes sliced
+            bm = np.stack([table.pad_bits(chunk_bits[i]) for i in sel])
+            hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
+            sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
+            ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
+            COUNTERS.inc("batch_verify")
+            pending.append((ok, n))
+        for ok, n in pending:
+            # all programs are in flight; this loop only drains results
+            results.extend(bool(x) for x in np.asarray(ok)[:n])
+        return results
+
+    def fallback():
+        return [
+            _ref_agg_verify(table, bits, h, sig)
+            for bits, h, sig in zip(bits_list, h_points, sig_points)
+        ]
+
+    return _guarded("batch_verify", dispatch, fallback)
 
 
 def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
@@ -374,31 +476,43 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
 
     pk_point: reference affine G1 point; sig_point: affine G2 point;
     payload: signed bytes (hash-to-G2 stays host-side per SURVEY §7.2).
+    Breaker-guarded with a host bigint fallback like the fused paths.
     """
-    import numpy as np
-
-    from .ops import interop as I
     from .ref.hash_to_curve import hash_to_g2
 
-    if kernel_twin_active():
-        asarray = np.asarray
-        OB = None  # twins only: jax stays unloaded
-    else:
-        import jax.numpy as jnp
-
-        from .ops import bls as OB
-
-        asarray = jnp.asarray
     h = hash_to_g2(payload)
-    # fused: pad to the pinned bucket so one compiled program serves
-    # every single check; eager (CPU): width 1, no padding — each lane
-    # would re-run the whole pairing op-by-op.  Twin kernels skip the
-    # padding: each lane costs a real host check
-    width = _VERIFY_BUCKET if _fused() and not kernel_twin_active() else 1
-    pk = np.asarray(I.g1_batch_affine([pk_point] * width))
-    hh = np.asarray(I.g2_batch_affine([h] * width))
-    sg = np.asarray(I.g2_batch_affine([sig_point] * width))
-    fn = _get_verify_fn() if _fused() else OB.verify
-    ok = fn(asarray(pk), asarray(hh), asarray(sg))
     COUNTERS.inc("verify")
-    return bool(np.asarray(ok)[0])
+
+    def dispatch() -> bool:
+        import numpy as np
+
+        from .ops import interop as I
+
+        if kernel_twin_active():
+            asarray = np.asarray
+            OB = None  # twins only: jax stays unloaded
+        else:
+            import jax.numpy as jnp
+
+            from .ops import bls as OB
+
+            asarray = jnp.asarray
+        # fused: pad to the pinned bucket so one compiled program serves
+        # every single check; eager (CPU): width 1, no padding — each
+        # lane would re-run the whole pairing op-by-op.  Twin kernels
+        # skip the padding: each lane costs a real host check
+        width = (_VERIFY_BUCKET
+                 if _fused() and not kernel_twin_active() else 1)
+        pk = np.asarray(I.g1_batch_affine([pk_point] * width))
+        hh = np.asarray(I.g2_batch_affine([h] * width))
+        sg = np.asarray(I.g2_batch_affine([sig_point] * width))
+        fn = _get_verify_fn() if _fused() else OB.verify
+        ok = fn(asarray(pk), asarray(hh), asarray(sg))
+        return bool(np.asarray(ok)[0])
+
+    def fallback() -> bool:
+        from .ref import bls as RB
+
+        return RB.verify_hashed(pk_point, h, sig_point)
+
+    return _guarded("verify", dispatch, fallback)

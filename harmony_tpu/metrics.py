@@ -165,6 +165,7 @@ class Registry:
             metrics = list(self._metrics.values())
         lines = [m.expose() for m in metrics]
         lines.append(self._device_counters())
+        lines.append(self._resilience_counters())
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -191,6 +192,26 @@ class Registry:
             f"harmony_device_kernel_twin "
             f"{1 if DV.kernel_twin_active() else 0}"
         )
+        return "\n".join(out)
+
+    @staticmethod
+    def _resilience_counters() -> str:
+        """Circuit-breaker lifecycle (resilience.TRANSITIONS): lets a
+        localnet run ASSERT over HTTP that the node NOTICED a flapping
+        backend (open/half_open/close) instead of silently degrading."""
+        from . import resilience as RS
+
+        out = [
+            "# HELP harmony_resilience_events_total circuit-breaker "
+            "transitions and rejected dispatches",
+            "# TYPE harmony_resilience_events_total counter",
+        ]
+        for key, v in RS.TRANSITIONS.items():
+            breaker, _, event = key.partition(":")
+            out.append(
+                "harmony_resilience_events_total"
+                f'{{breaker="{breaker}",event="{event}"}} {v}'
+            )
         return "\n".join(out)
 
 

@@ -19,6 +19,7 @@ import socket
 import struct
 import threading
 
+from .. import faultinject as FI
 from ..core import rawdb
 from ..core.types import _enc_bytes, _enc_int
 from ..core.types import Reader as _Reader
@@ -203,6 +204,12 @@ class SyncServer:
     def close(self):
         self._closing = True
         try:
+            # wake the blocked accept NOW (a bare close is deferred
+            # while another thread sits in accept on this fd)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
         except OSError:
             pass
@@ -236,6 +243,7 @@ class SyncClient:
     def __init__(self, port: int, host: str = "127.0.0.1",
                  timeout: float = 30.0):
         self._addr = (host, port)
+        self.peer_key = f"{host}:{port}"  # faultinject/log identity
         self._timeout = timeout
         self._sock: socket.socket | None = None
         self._next_id = 0
@@ -243,15 +251,31 @@ class SyncClient:
         self._send_lock = threading.Lock()  # frame atomicity only
         self._pending: dict[int, _PendingReply] = {}
 
-    def _ensure_connected(self) -> socket.socket:
+    def _ensure_connected(self, deadline=None) -> socket.socket:
         """Current socket, dialing lazily — the dial itself (a blocking
         connect with a long timeout) runs with NO lock held; racing
-        dialers resolve by the loser closing its spare socket."""
+        dialers resolve by the loser closing its spare socket.  The
+        caller's deadline bounds the dial too: a peer black-holed at
+        connect time costs the request budget, not the stream's full
+        default timeout."""
         with self._lock:
             if self._sock is not None:
                 return self._sock
+        dial_timeout = (self._timeout if deadline is None
+                        else deadline.bound(self._timeout))
+        if dial_timeout is not None and dial_timeout <= 0:
+            raise ConnectionError("sync request deadline exhausted")
         sock = socket.create_connection(self._addr,
-                                        timeout=self._timeout)
+                                        timeout=dial_timeout)
+        # TCP self-connect quirk: dialing a freed localhost port can
+        # land on our own ephemeral port and "succeed" — a dead peer
+        # must look dead, not echo our frames back
+        if sock.getsockname() == sock.getpeername():
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionError("self-connected socket (peer is down)")
         # blocking mode from here: the reader thread recvs continuously
         # and must survive idle periods; per-call deadlines are enforced
         # by the waiter's event timeout, not the socket
@@ -303,12 +327,30 @@ class SyncClient:
         for slot in stale:
             slot.event.set()  # body stays None -> waiter raises
         try:
+            # shutdown first: a bare close() while the reader thread is
+            # blocked in recv is deferred by the kernel (no FIN, reader
+            # stays parked); shutdown wakes it with EOF immediately
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             sock.close()
         except OSError:
             pass
 
-    def _call(self, payload: bytes) -> bytes:
-        sock = self._ensure_connected()
+    def _call(self, payload: bytes, deadline=None) -> bytes:
+        """One request/response.  ``deadline`` (a resilience.Deadline)
+        tightens this call's wait below the stream's default timeout —
+        the downloader propagates one budget across a whole stage so a
+        black-holed peer costs bounded time, not 30 s per request."""
+        FI.fire("p2p.stream", key=self.peer_key)
+        sock = self._ensure_connected(deadline)
+        # the wait budget is re-taken AFTER the dial so a slow connect
+        # and the response wait share ONE deadline, not two
+        timeout = (self._timeout if deadline is None
+                   else deadline.bound(self._timeout))
+        if timeout is not None and timeout <= 0:
+            raise ConnectionError("sync request deadline exhausted")
         with self._lock:
             self._next_id += 1
             req_id = self._next_id
@@ -326,7 +368,7 @@ class SyncClient:
             except OSError:
                 self._drop(sock)
                 raise
-            if not slot.event.wait(self._timeout):
+            if not slot.event.wait(timeout):
                 self._drop(sock)  # wedged peer: fail everyone, redial
                 raise ConnectionError("sync request timed out")
             if slot.body is None:
@@ -336,22 +378,26 @@ class SyncClient:
             with self._lock:
                 self._pending.pop(req_id, None)
 
-    def get_head(self) -> tuple[int, bytes]:
-        resp = self._call(bytes([METHOD_HEAD]))
+    def get_head(self, deadline=None) -> tuple[int, bytes]:
+        resp = self._call(bytes([METHOD_HEAD]), deadline)
         return int.from_bytes(resp[:8], "little"), resp[8:40]
 
-    def get_block_hashes(self, start: int, count: int) -> list:
+    def get_block_hashes(self, start: int, count: int,
+                         deadline=None) -> list:
         resp = self._call(
             bytes([METHOD_BLOCK_HASHES])
-            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little"),
+            deadline,
         )
         return [resp[i:i + 32] for i in range(0, len(resp), 32)]
 
-    def get_blocks_by_number(self, start: int, count: int) -> list:
+    def get_blocks_by_number(self, start: int, count: int,
+                             deadline=None) -> list:
         """[(Block, commit_sig_or_None)] — the replay feed."""
         resp = self._call(
             bytes([METHOD_BLOCKS_BY_NUM])
-            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little"),
+            deadline,
         )
         r = _Reader(resp)
         out = []
@@ -367,13 +413,14 @@ class SyncClient:
             )
         return out
 
-    def get_receipts(self, start: int, count: int) -> list:
+    def get_receipts(self, start: int, count: int, deadline=None) -> list:
         """[[Receipt]] — one list per block from ``start``."""
         from ..core.types import Receipt
 
         resp = self._call(
             bytes([METHOD_RECEIPTS])
-            + start.to_bytes(8, "little") + count.to_bytes(4, "little")
+            + start.to_bytes(8, "little") + count.to_bytes(4, "little"),
+            deadline,
         )
         r = _Reader(resp)
         out = []
@@ -383,12 +430,14 @@ class SyncClient:
         return out
 
     def get_account_range(self, num: int, start_addr: bytes = b"",
-                          limit: int = MAX_ACCOUNTS_PER_REQUEST) -> list:
+                          limit: int = MAX_ACCOUNTS_PER_REQUEST,
+                          deadline=None) -> list:
         """[(addr, account blob)] of the remote state at block ``num``,
         strictly after ``start_addr``; page until a short page."""
         resp = self._call(
             bytes([METHOD_ACCOUNT_RANGE]) + num.to_bytes(8, "little")
-            + _enc_bytes(start_addr) + limit.to_bytes(4, "little")
+            + _enc_bytes(start_addr) + limit.to_bytes(4, "little"),
+            deadline,
         )
         r = _Reader(resp)
         n = r.int_(4)
@@ -396,11 +445,12 @@ class SyncClient:
             raise ConnectionError(f"peer has no state at block {num}")
         return [(r.bytes_(), r.bytes_()) for _ in range(n)]
 
-    def get_epoch_state(self, epoch: int):
+    def get_epoch_state(self, epoch: int, deadline=None):
         """The elected shard State recorded for ``epoch`` on the remote
         chain, or None (feeds the beacon EpochChain)."""
         resp = self._call(
-            bytes([METHOD_EPOCH_STATE]) + epoch.to_bytes(8, "little")
+            bytes([METHOD_EPOCH_STATE]) + epoch.to_bytes(8, "little"),
+            deadline,
         )
         if not resp:
             return None

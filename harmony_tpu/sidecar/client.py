@@ -1,58 +1,292 @@
 """Python sidecar client (tests + Python-side nodes).  The C++ twin for
-non-Python hosts lives in native/sidecar_client.cpp."""
+non-Python hosts lives in native/sidecar_client.cpp.
+
+Resilient by contract (the failure-mode matrix in docs/ANALYSIS.md):
+
+- every RPC runs under a ``Deadline`` (connect + call timeouts bound
+  every socket wait — the r5 client blocked forever in ``recv``);
+- ANY error mid-call fails CLOSED: the connection is dropped, every
+  in-flight waiter gets a typed ``SidecarUnavailable``, and the next
+  call redials.  A half-read frame or mismatched reply can therefore
+  never leave ``_req_id`` out of step and poison later calls;
+- reconnect happens lazily with bounded backoff (``RetryPolicy``), and
+  committee state is REPLAYED onto the fresh connection before any
+  request uses it — ``agg_verify`` never hits STATUS_UNKNOWN_COMMITTEE
+  just because the sidecar restarted;
+- requests are pipelined like p2p/stream.SyncClient: a reader thread
+  demultiplexes replies by request id, so no lock is ever held across
+  socket I/O (GL06) and concurrent callers overlap on the wire.
+"""
 
 from __future__ import annotations
 
 import socket
+import threading
 
+from .. import faultinject as FI
+from ..log import get_logger
+from ..resilience import Deadline, RetryPolicy
 from . import protocol as P
+
+_log = get_logger("sidecar")
+
+
+class SidecarUnavailable(ConnectionError):
+    """The sidecar cannot serve this call within its deadline.  The
+    connection has been dropped (fail closed); a later call redials
+    and replays committee state."""
+
+
+class _Pending:
+    __slots__ = ("event", "frame")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.frame: tuple | None = None  # (resp type, body) when set
 
 
 class SidecarClient:
-    def __init__(self, address):
-        if isinstance(address, str):
-            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        else:
-            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.connect(address)
+    def __init__(self, address, connect_timeout: float = 5.0,
+                 call_timeout: float = 10.0,
+                 retry: RetryPolicy | None = None):
+        self._address = address
+        self._connect_timeout = connect_timeout
+        self._call_timeout = call_timeout
+        self._retry = retry or RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5
+        )
+        self._lock = threading.Lock()  # socket slot + req ids + pending
+        self._send_lock = threading.Lock()  # frame atomicity only
+        self._sock: socket.socket | None = None
+        self._ready = threading.Event()  # committee replay finished
         self._req_id = 0
+        self._pending: dict[int, _Pending] = {}
+        # (epoch, shard) -> serialized pubkeys, replayed on reconnect
+        self._committees: dict = {}
+        # constructor contract: a dead address fails NOW, not on first
+        # use (matches the r5 client; SidecarUnavailable is a
+        # ConnectionError so existing callers keep working)
+        self._ensure_connected(Deadline.after(connect_timeout))
+
+    # -- connection lifecycle ------------------------------------------------
+
+    def _dial(self, timeout: float) -> socket.socket:
+        if isinstance(self._address, str):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(self._address)
+            # TCP self-connect quirk: dialing a FREED localhost port can
+            # land on the dialer's own ephemeral port and "succeed" —
+            # the frames we send would echo back as garbage responses.
+            # A dead sidecar must look dead.
+            if (sock.family == socket.AF_INET
+                    and sock.getsockname() == sock.getpeername()):
+                raise ConnectionError("self-connected socket "
+                                      "(sidecar is down)")
+        except OSError:
+            sock.close()
+            raise
+        # blocking mode from here: the reader thread recvs continuously;
+        # per-call deadlines are enforced by each waiter's event timeout
+        sock.settimeout(None)
+        return sock
+
+    def _ensure_connected(self, deadline: Deadline) -> socket.socket:
+        """Current socket, dialing lazily.  The dial winner replays the
+        cached committee state BEFORE ``_ready`` is set; racing callers
+        wait on it so no request can race ahead of the replay and draw
+        a spurious STATUS_UNKNOWN_COMMITTEE."""
+        with self._lock:
+            sock, ready = self._sock, self._ready
+        if sock is None:
+            # the caller's deadline bounds the dial: a dead sidecar
+            # costs at most the remaining budget, never a full
+            # connect_timeout past it (no lock held: blocking connect)
+            deadline.check("sidecar dial")
+            dialed = self._dial(deadline.bound(self._connect_timeout))
+            replay = False
+            with self._lock:
+                if self._sock is None:
+                    self._sock = sock = dialed
+                    self._ready = ready = threading.Event()
+                    replay = True
+                    threading.Thread(
+                        target=self._read_loop, args=(dialed,),
+                        daemon=True,
+                    ).start()
+                else:
+                    sock, ready = self._sock, self._ready
+            if replay:
+                try:
+                    self._replay_committees(sock, deadline)
+                except BaseException:
+                    self._drop(sock)
+                    raise
+                ready.set()
+                return sock
+            try:
+                dialed.close()  # lost the dial race: spare socket
+            except OSError:
+                pass
+        if not ready.wait(deadline.bound(self._call_timeout)):
+            raise SidecarUnavailable("sidecar committee replay stalled")
+        return sock
+
+    def _replay_committees(self, sock, deadline: Deadline) -> None:
+        with self._lock:
+            cached = sorted(self._committees.items())
+        for (epoch, shard), pubkeys in cached:
+            status, _ = self._request(
+                sock, P.MSG_SET_COMMITTEE,
+                P.build_set_committee(epoch, shard, pubkeys), deadline,
+            )
+            if status != P.STATUS_OK:
+                raise SidecarUnavailable(
+                    f"committee replay refused: status {status}"
+                )
+        if cached:
+            _log.info("sidecar committees replayed", count=len(cached))
+
+    def _read_loop(self, sock) -> None:
+        """Demultiplex response frames to their waiters by request id.
+        Any protocol violation — truncated frame, garbage, a reply to
+        an id nobody is waiting on — is a stream desync: fail closed."""
+        while True:
+            try:
+                FI.fire("sidecar.frame")
+                frame = P.read_frame(sock)
+            except (ValueError, OSError):
+                break  # garbage or dead socket: never trust the stream
+            if frame is None:
+                break  # clean EOF
+            rtype, rid, rbody = frame
+            with self._lock:
+                slot = self._pending.get(rid)
+            if slot is None:
+                break  # reply to nobody: mid-frame desync, fail closed
+            slot.frame = (rtype, rbody)
+            slot.event.set()
+        self._drop(sock)
+
+    def _drop(self, sock) -> None:
+        """Retire a socket and fail every waiter parked on it.  Only
+        the CURRENT socket's death clears the pending map — a stale
+        reader unwinding after a redial must not kill healthy waiters
+        registered against the new connection."""
+        stale: list = []
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+                stale = list(self._pending.values())
+                self._pending.clear()
+        for slot in stale:
+            slot.event.set()  # frame stays None -> waiter raises
+        try:
+            # shutdown first: a bare close() while the reader thread is
+            # blocked in recv is deferred by the kernel (no FIN, reader
+            # stays parked); shutdown wakes it with EOF immediately
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def close(self):
-        self._sock.close()
+        with self._lock:
+            s = self._sock
+        if s is not None:
+            self._drop(s)
 
-    def _call(self, msg_type: int, body: bytes):
-        self._req_id += 1
-        self._sock.sendall(P.pack_frame(msg_type, self._req_id, body))
-        frame = P.read_frame(self._sock)
-        if frame is None:
-            raise ConnectionError("sidecar closed connection")
-        rtype, rid, rbody = frame
-        if rtype != (msg_type | P.RESP_FLAG) or rid != self._req_id:
-            raise ValueError("response mismatch")
-        if not rbody:
-            raise ValueError("empty response")
-        return rbody[0], rbody[1:]
+    # -- framed RPC ----------------------------------------------------------
 
-    def ping(self) -> int:
-        status, body = self._call(P.MSG_PING, b"")
+    def _request(self, sock, msg_type: int, body: bytes,
+                 deadline: Deadline):
+        with self._lock:
+            self._req_id += 1
+            rid = self._req_id
+            slot = _Pending()
+            self._pending[rid] = slot
+        try:
+            try:
+                # _send_lock only keeps concurrent frames from
+                # interleaving; the response wait below runs with NO
+                # lock held, so calls overlap on the wire
+                with self._send_lock:
+                    sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
+                        P.pack_frame(msg_type, rid, body)
+                    )
+            except OSError as e:
+                self._drop(sock)
+                raise SidecarUnavailable(
+                    f"sidecar send failed: {e}"
+                ) from e
+            if not slot.event.wait(deadline.bound(self._call_timeout)):
+                self._drop(sock)  # wedged sidecar: fail closed, redial
+                raise SidecarUnavailable("sidecar call timed out")
+            if slot.frame is None:
+                raise SidecarUnavailable("sidecar connection lost")
+            rtype, rbody = slot.frame
+            if rtype != (msg_type | P.RESP_FLAG):
+                self._drop(sock)  # wrong reply type: stream desync
+                raise SidecarUnavailable("sidecar response type mismatch")
+            if not rbody:
+                self._drop(sock)
+                raise SidecarUnavailable("empty sidecar response")
+            return rbody[0], rbody[1:]
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+    def _call(self, msg_type: int, body: bytes,
+              deadline: Deadline | None = None):
+        dl = deadline or Deadline.after(self._call_timeout)
+        FI.fire("sidecar.call")
+
+        def attempt():
+            sock = self._ensure_connected(dl)
+            return self._request(sock, msg_type, body, dl)
+
+        try:
+            return self._retry.run(
+                attempt, retry_on=(OSError,), deadline=dl, key="sidecar"
+            )
+        except SidecarUnavailable:
+            raise
+        except OSError as e:  # dial failures, DeadlineExceeded
+            raise SidecarUnavailable(f"sidecar unreachable: {e}") from e
+
+    # -- API -----------------------------------------------------------------
+
+    def ping(self, deadline: Deadline | None = None) -> int:
+        status, body = self._call(P.MSG_PING, b"", deadline)
         if status != P.STATUS_OK:
             raise RuntimeError(f"ping failed: {status}")
         return int.from_bytes(body[:2], "little")
 
-    def set_committee(self, epoch: int, shard: int, pubkeys: list):
+    def set_committee(self, epoch: int, shard: int, pubkeys: list,
+                      deadline: Deadline | None = None):
         status, _ = self._call(
-            P.MSG_SET_COMMITTEE, P.build_set_committee(epoch, shard, pubkeys)
+            P.MSG_SET_COMMITTEE,
+            P.build_set_committee(epoch, shard, pubkeys), deadline,
         )
         if status != P.STATUS_OK:
             raise RuntimeError(f"set_committee failed: {status}")
+        with self._lock:
+            self._committees[(epoch, shard)] = list(pubkeys)
 
     def agg_verify(
         self, epoch: int, shard: int, payload: bytes, bitmap: bytes,
-        sig: bytes,
+        sig: bytes, deadline: Deadline | None = None,
     ) -> bool:
         status, body = self._call(
             P.MSG_AGG_VERIFY,
             P.build_agg_verify(epoch, shard, payload, bitmap, sig),
+            deadline,
         )
         if status == P.STATUS_UNKNOWN_COMMITTEE:
             raise KeyError(f"no committee for epoch {epoch} shard {shard}")
@@ -60,9 +294,10 @@ class SidecarClient:
             raise RuntimeError(f"agg_verify failed: {status}")
         return bool(body[0])
 
-    def verify_batch(self, items: list) -> list:
+    def verify_batch(self, items: list,
+                     deadline: Deadline | None = None) -> list:
         status, body = self._call(
-            P.MSG_VERIFY_BATCH, P.build_verify_batch(items)
+            P.MSG_VERIFY_BATCH, P.build_verify_batch(items), deadline
         )
         if status != P.STATUS_OK:
             raise RuntimeError(f"verify_batch failed: {status}")

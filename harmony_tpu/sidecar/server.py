@@ -56,6 +56,7 @@ class SidecarServer:
         self._sock.listen(16)
         self._stop = threading.Event()
         self._thread = None
+        self._conns: set = set()  # live client conns, closed on stop
 
     # --- lifecycle ---
     def start(self):
@@ -64,11 +65,26 @@ class SidecarServer:
         return self
 
     def stop(self):
+        """Shut down the listener AND every live connection: a stopped
+        sidecar must look DEAD to its clients (their reader threads get
+        EOF and fail closed), not linger half-alive on old sockets.
+        shutdown() before close() matters: a bare close() of a socket
+        another thread is blocked recv'ing/accept'ing on is DEFERRED by
+        the kernel until that syscall exits — no FIN is ever sent and
+        the 'stopped' server keeps serving established connections."""
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in [self._sock] + conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -76,6 +92,8 @@ class SidecarServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -96,6 +114,8 @@ class SidecarServer:
         except (ValueError, OSError):
             pass
         finally:
+            with self._lock:
+                self._conns.discard(conn)
             conn.close()
 
     # --- request handling ---

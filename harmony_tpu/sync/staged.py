@@ -17,6 +17,7 @@ from collections import Counter
 from dataclasses import dataclass, field
 
 from ..log import get_logger
+from ..resilience import Deadline
 
 BATCH = 64  # blocks per fetch/verify window
 
@@ -36,14 +37,40 @@ class SyncResult:
 
 class Downloader:
     def __init__(self, chain, clients: list, batch: int = BATCH,
-                 verify_seals: bool = True):
+                 verify_seals: bool = True,
+                 request_deadline_s: float | None = None):
         """clients: [SyncClient] — one per serving peer.  verify_seals
         routes through the chain engine's batched pairing check; False
-        only for chains whose proofs were already consensus-verified."""
+        only for chains whose proofs were already consensus-verified.
+
+        request_deadline_s bounds EVERY peer request (tighter than the
+        stream's own 30 s default); a peer that times out or errors
+        mid-stage is EXCLUDED for the rest of the pass and the stage
+        completes from the remaining peers — one black-holed peer costs
+        one deadline, not one deadline per window."""
         self.chain = chain
         self.clients = list(clients)
         self.batch = batch
         self.verify_seals = verify_seals
+        self.request_deadline_s = request_deadline_s
+        self._excluded: set = set()  # id(client), reset per pass
+
+    def _deadline(self) -> Deadline | None:
+        if self.request_deadline_s is None:
+            return None
+        return Deadline.after(self.request_deadline_s)
+
+    def _peers(self) -> list:
+        """Healthy peers, in configured order."""
+        return [c for c in self.clients if id(c) not in self._excluded]
+
+    def _exclude(self, client, stage: str, err) -> None:
+        self._excluded.add(id(client))
+        _log.warn(
+            "sync peer excluded for this pass", stage=stage,
+            peer=getattr(client, "peer_key", "?"), error=str(err),
+            remaining=len(self._peers()),
+        )
 
     # -- stage: heads -------------------------------------------------------
 
@@ -51,11 +78,12 @@ class Downloader:
         """Highest head any peer advertises (short-range trust model:
         the commit-sig verification below is what actually gates)."""
         best = self.chain.head_number
-        for c in self.clients:
+        for c in self._peers():
             try:
-                head, _ = c.get_head()
+                head, _ = c.get_head(deadline=self._deadline())
                 best = max(best, head)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "heads", e)
                 continue
         return best
 
@@ -65,10 +93,13 @@ class Downloader:
         """Per-height majority hash across peers (the reference's
         stage_short_range cross-peer consistency check)."""
         votes: list[Counter] = [Counter() for _ in range(count)]
-        for c in self.clients:
+        for c in self._peers():
             try:
-                hashes = c.get_block_hashes(start, count)
-            except (ConnectionError, OSError):
+                hashes = c.get_block_hashes(
+                    start, count, deadline=self._deadline()
+                )
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "hashes", e)
                 continue
             for i, h in enumerate(hashes[:count]):
                 votes[i][h] += 1
@@ -84,10 +115,13 @@ class Downloader:
     def _fetch_window(self, start: int, count: int, want_hashes: list):
         """Try peers in order until one serves blocks matching the
         agreed hashes."""
-        for c in self.clients:
+        for c in self._peers():
             try:
-                items = c.get_blocks_by_number(start, count)
-            except (ConnectionError, OSError):
+                items = c.get_blocks_by_number(
+                    start, count, deadline=self._deadline()
+                )
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "bodies", e)
                 continue
             if not items:
                 continue
@@ -111,11 +145,13 @@ class Downloader:
         # generous sanity bound on total pages: a state bigger than
         # this is not something fast sync should swallow silently
         max_pages = int(1e6)
-        for c in self.clients:
+        for c in self._peers():
             try:
                 start = b""
                 for _ in range(max_pages):
-                    page = c.get_account_range(num, start)
+                    page = c.get_account_range(
+                        num, start, deadline=self._deadline()
+                    )
                     if not page:
                         break
                     # progress guard (ADVICE r4): a peer repeating or
@@ -132,7 +168,8 @@ class Downloader:
                 else:
                     raise ConnectionError("account-range page bound hit")
                 return StateDB(accounts)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "states", e)
                 accounts.clear()
                 continue
         return None
@@ -144,6 +181,7 @@ class Downloader:
         blocks, then the account set of the head state (bound to the
         sealed state root in adopt_state), then receipts for the
         recent tail so tx-facing RPCs answer."""
+        self._excluded.clear()  # every peer gets a fresh chance per pass
         res = SyncResult(target=self.network_head())
         head = self.chain.head_number
         if res.target <= head:
@@ -197,10 +235,13 @@ class Downloader:
         from ..core.types import receipts_root as _rroot
 
         lo = max(head + 1, last_inserted - receipts_tail + 1)
-        for c in self.clients:
+        for c in self._peers():
             try:
-                per_block = c.get_receipts(lo, last_inserted - lo + 1)
-            except (ConnectionError, OSError):
+                per_block = c.get_receipts(
+                    lo, last_inserted - lo + 1, deadline=self._deadline()
+                )
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "receipts", e)
                 continue
             verified = []
             for i, receipts in enumerate(per_block):
@@ -227,6 +268,7 @@ class Downloader:
 
     def sync_once(self) -> SyncResult:
         """One pass to the current network head."""
+        self._excluded.clear()  # every peer gets a fresh chance per pass
         res = SyncResult(target=self.network_head())
         if res.target > self.chain.head_number:
             _log.info(

@@ -15,9 +15,17 @@ import threading
 import urllib.request
 from collections import deque
 
+from . import faultinject as FI
 from .log import get_logger
+from .resilience import RetryPolicy
 
 _log = get_logger("webhooks")
+
+# shared POST retry: 3 attempts, exponential backoff, deterministic
+# jitter — an operator endpoint that hiccups for a second still gets
+# its double-sign report; one that stays down costs three bounded
+# attempts and a logged drop, never a hung thread pile-up
+_POST_RETRY = RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=1.0)
 
 
 class Hooks:
@@ -48,9 +56,15 @@ class Hooks:
                           error=str(e))
 
 
-def http_post_hook(url: str, timeout: float = 5.0):
+def http_post_hook(url: str, timeout: float = 5.0,
+                   retry: RetryPolicy | None = None):
     """A hook that POSTs the payload as JSON (fire-and-forget thread —
-    the reference's report hook is likewise non-blocking)."""
+    the reference's report hook is likewise non-blocking).  Each
+    delivery makes up to ``retry.attempts`` bounded attempts with
+    backoff; the final failure is a logged drop, exactly as before —
+    an unreachable operator endpoint must never back-pressure
+    consensus."""
+    policy = retry or _POST_RETRY
 
     def hook(payload: dict):
         def send():
@@ -59,10 +73,17 @@ def http_post_hook(url: str, timeout: float = 5.0):
                 data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"},
             )
-            try:
+
+            def attempt():
+                FI.fire("webhook.post")
                 urllib.request.urlopen(req, timeout=timeout).close()
-            except OSError:
-                pass
+
+            try:
+                policy.run(attempt, retry_on=(OSError,), key=url)
+            except OSError as e:
+                _log.warn("webhook POST dropped after retries",
+                          url=url, error=str(e),
+                          attempts=policy.attempts)
 
         threading.Thread(target=send, daemon=True).start()
 

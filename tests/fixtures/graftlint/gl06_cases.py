@@ -35,7 +35,7 @@ def recv_under_lock(sock):
 
 def dial_under_lock(addr):
     with _L:
-        return socket.create_connection(addr)  # expect: GL06
+        return socket.create_connection(addr)  # expect: GL06, GL08
 
 
 def join_under_lock():

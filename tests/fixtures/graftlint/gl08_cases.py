@@ -1,0 +1,99 @@
+"""GL08 fixture: unbounded blocking calls — socket connect/recv and
+urlopen reachable without a timeout ever being set.
+tests/test_graftlint.py asserts that exactly the lines tagged
+``# expect: GLxx`` are flagged.
+
+Covers: connect/recv on timeout-less sockets (local and self-attr,
+with the timeout recognized ACROSS methods), bounded dials via
+settimeout and create_connection(timeout=...), urlopen with/without a
+timeout, the interprocedural case (a timeout-less socket passed into a
+helper that recvs on it), a callee that bounds its own parameter, and
+an inline suppression.
+"""
+
+import socket
+import urllib.request
+
+
+def dial_no_timeout(addr):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect(addr)  # expect: GL08
+    return s
+
+
+def dial_with_timeout(addr):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.settimeout(5)
+    s.connect(addr)
+    return s
+
+
+def recv_after_bounded_dial(addr):
+    s = socket.create_connection(addr, timeout=3)
+    return s.recv(4)
+
+
+def recv_after_unbounded_dial(addr):
+    s = socket.create_connection(addr)  # expect: GL08
+    return s.recv(4)  # expect: GL08
+
+
+def fetch_no_timeout(url):
+    return urllib.request.urlopen(url)  # expect: GL08
+
+
+def fetch_with_timeout(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+class Client:
+    def __init__(self, addr):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.connect(addr)  # expect: GL08
+
+    def read(self):
+        return self._sock.recv(4)  # expect: GL08
+
+
+class BoundedClient:
+    """settimeout in __init__ bounds the recv in a SIBLING method —
+    the class-wide view the whole-program pass provides."""
+
+    def __init__(self, addr):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(2)
+        self._sock.connect(addr)
+
+    def read(self):
+        return self._sock.recv(4)
+
+
+def _read_exact(sock, n):
+    return sock.recv(n)
+
+
+class Framed:
+    def __init__(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+
+    def read_frame(self):
+        return _read_exact(self._sock, 4)  # expect: GL08
+
+
+def bounded_param_flow(addr):
+    s = socket.create_connection(addr, timeout=1)
+    return _read_exact(s, 4)
+
+
+def callee_sets_timeout(sock):
+    sock.settimeout(1)
+    return sock.recv(4)
+
+
+def suppressed_dial(addr):
+    s = socket.create_connection(addr)  # graftlint: disable=GL08 bounded by the caller's alarm
+    return s.recv(4)  # expect: GL08
+
+
+def fetch_explicit_none_timeout(url):
+    return urllib.request.urlopen(url, timeout=None)  # expect: GL08

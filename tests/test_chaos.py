@@ -1,0 +1,433 @@
+"""Chaos tier: consensus keeps finalizing while the TPU backend flaps
+and a sync peer is black-holed (the ISSUE 3 acceptance scenario).
+
+Device kernels are the bigint twins (same trick as test_device_path:
+real verify decisions, no XLA pairing compiles on the CPU image) and
+``device.use_device(True)`` forces the device branches, so every fault
+injected at ``device.dispatch`` hits the REAL dispatch path — breaker,
+fallback, counters — not a mock.  All faults are armed through
+harmony_tpu.faultinject with fixed counting rules: deterministic,
+replayable, seed-free.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu import device as DV
+from harmony_tpu import faultinject as FI
+from harmony_tpu.chain.engine import Engine, EpochContext
+from harmony_tpu.chain.header import Header
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.consensus.signature import construct_commit_payload
+from harmony_tpu.ops import bls as OB
+from harmony_tpu.ops import interop as I
+from harmony_tpu.ref import bls as RB
+from harmony_tpu.ref.curve import g1
+from harmony_tpu.resilience import TRANSITIONS, CircuitBreaker
+
+N_KEYS = 4
+
+
+def _aff_g1(arr):
+    return (I.arr_to_fp(arr[0]), I.arr_to_fp(arr[1]))
+
+
+def _aff_g2(arr):
+    return (I.arr_to_fp2(arr[0]), I.arr_to_fp2(arr[1]))
+
+
+def _twin_agg_verify(pk_affs, bitmap, h_aff, agg_sig_aff):
+    tbl = np.asarray(pk_affs)
+    bits = np.asarray(bitmap)
+    agg = None
+    for i, bit in enumerate(bits):
+        if bit:
+            agg = g1.add(agg, _aff_g1(tbl[i]))
+    if agg is None:
+        return np.asarray(False)
+    return np.asarray(RB.verify_hashed(
+        agg, _aff_g2(np.asarray(h_aff)), _aff_g2(np.asarray(agg_sig_aff))
+    ))
+
+
+def _twin_agg_verify_batch(pk_affs, bitmaps, h_affs, agg_sig_affs):
+    return np.asarray([
+        bool(_twin_agg_verify(pk_affs, bm, h, s))
+        for bm, h, s in zip(
+            np.asarray(bitmaps), np.asarray(h_affs),
+            np.asarray(agg_sig_affs),
+        )
+    ])
+
+
+def _twin_verify(pk_affs, h_affs, sig_affs):
+    return np.asarray([
+        RB.verify_hashed(_aff_g1(pk), _aff_g2(h), _aff_g2(s))
+        for pk, h, s in zip(
+            np.asarray(pk_affs), np.asarray(h_affs), np.asarray(sig_affs)
+        )
+    ])
+
+
+@pytest.fixture(scope="module", autouse=True)
+def force_device_with_twin_kernels():
+    DV.use_device(True)
+    saved = (OB.agg_verify, OB.agg_verify_batch, OB.verify)
+    OB.agg_verify = _twin_agg_verify
+    OB.agg_verify_batch = _twin_agg_verify_batch
+    OB.verify = _twin_verify
+    yield
+    OB.agg_verify, OB.agg_verify_batch, OB.verify = saved
+    DV.use_device(None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_breaker(monkeypatch, request):
+    """Fresh faults and a per-test breaker (unique name -> isolated
+    transition counters) so chaos state never leaks between tests."""
+    FI.reset()
+    brk = CircuitBreaker(f"chaos-{request.node.name}"[:60],
+                         failure_threshold=3, reset_timeout_s=0.05)
+    monkeypatch.setattr(DV, "BREAKER", brk)
+    yield brk
+    FI.reset()
+    DV.set_dispatch_deadline(None)
+
+
+@pytest.fixture(scope="module")
+def committee():
+    keys = [B.PrivateKey.generate(bytes([120 + i])) for i in range(N_KEYS)]
+    return keys, [k.pub.bytes for k in keys]
+
+
+def _provider(serialized):
+    def provide(shard_id, epoch):
+        return EpochContext(serialized)
+
+    return provide
+
+
+def _sign_header(header, keys, signer_idx):
+    payload = construct_commit_payload(
+        header.hash(), header.block_num, header.view_id, True
+    )
+    sigs = [keys[i].sign_hash(payload) for i in signer_idx]
+    agg = B.aggregate_sigs(sigs)
+    mask = Mask([k.pub.point for k in keys])
+    for i in signer_idx:
+        mask.set_bit(i, True)
+    return agg.bytes, mask.mask_bytes()
+
+
+def _tcount(brk, event):
+    return TRANSITIONS[f"{brk.name}:{event}"]
+
+
+# -- flapping backend: correctness through the fallback ----------------------
+
+
+def test_flapping_backend_still_verifies_correctly(committee):
+    """Backend raises on EVERY OTHER dispatch: every check still
+    returns the host-path answer (accepts AND rejects) via the
+    transparent reference fallback."""
+    keys, serialized = committee
+    FI.arm("device.dispatch", exc=RuntimeError, every=2)
+    before = DV.COUNTERS["ref_fallback"]
+    dev = Engine(_provider(serialized), device=True)
+    host = Engine(_provider(serialized), device=False)
+    h = Header(shard_id=0, block_num=77, epoch=5, view_id=77)
+    good_sig, good_bm = _sign_header(h, keys, [0, 1, 2])
+    bad_sig, _ = _sign_header(h, keys, [0, 1])
+    cases = [(good_sig, good_bm), (bad_sig, good_bm)] * 4
+    for sig, bm in cases:
+        # fresh engines would cache; compare uncached decisions
+        assert dev.verify_header_signature(h, sig, bm) == \
+            host.verify_header_signature(h, sig, bm)
+    assert DV.COUNTERS["ref_fallback"] > before  # fallback really ran
+    assert FI.hits("device.dispatch") > 0
+
+
+def test_flapping_backend_batch_replay_matches_host(committee):
+    keys, serialized = committee
+    FI.arm("device.dispatch", exc=ConnectionResetError, every=2)
+    dev = Engine(_provider(serialized), device=True)
+    host = Engine(_provider(serialized), device=False)
+    items = []
+    prev = bytes(32)
+    for n in range(10):
+        h = Header(shard_id=0, block_num=300 + n, epoch=6,
+                   view_id=300 + n, parent_hash=prev)
+        sig, bm = _sign_header(h, keys, [0, 1, 2, 3] if n % 2 else [0, 1, 2])
+        items.append((h, sig, bm))
+        prev = h.hash()
+    items[3] = (items[3][0], items[2][1], items[3][2])  # corrupt one
+    got = dev.verify_headers_batch(items)
+    want = host.verify_headers_batch(items)
+    assert got == want and got[3] is False
+
+
+# -- breaker lifecycle under sustained failure -------------------------------
+
+
+def test_breaker_opens_skips_device_then_recovers(committee, monkeypatch):
+    """Sustained failures trip the breaker OPEN (observed in metrics);
+    while open, dispatches skip the device entirely (fault hits stop
+    climbing) yet answers stay correct; after the reset timeout a
+    half-open probe re-admits the TPU and the breaker closes.  The
+    breaker clock is injected: transitions happen exactly when this
+    test advances time, never under it."""
+    keys, serialized = committee
+    now = [0.0]
+    brk = CircuitBreaker("chaos-recovery", failure_threshold=3,
+                         reset_timeout_s=10.0, clock=lambda: now[0])
+    monkeypatch.setattr(DV, "BREAKER", brk)
+    ctx = EpochContext(serialized)
+    payload = b"chaos-breaker-payload-32-bytes!!"
+    sigs = [keys[i].sign_hash(payload) for i in range(3)]
+    agg = B.aggregate_sigs(sigs)
+    bits = [1, 1, 1, 0]
+
+    def check():
+        return DV.agg_verify_on_device(
+            ctx.committee_table(), bits, payload, agg.point
+        )
+
+    FI.arm("device.dispatch", exc=RuntimeError)  # hard down
+    for _ in range(3):  # threshold=3 consecutive failures
+        assert check()  # correct via fallback every time
+    assert brk.state == "open"
+    assert _tcount(brk, "open") == 1
+
+    hits_when_open = FI.hits("device.dispatch")
+    for _ in range(4):
+        assert check()  # still correct, device never touched
+    assert FI.hits("device.dispatch") == hits_when_open
+    assert _tcount(brk, "rejected") >= 4
+
+    FI.reset()  # backend heals
+    # passive counting rule (times=0 never fires): keeps the registry
+    # armed so hits() still observes device liveness
+    FI.arm("device.dispatch", exc=RuntimeError, times=0)
+    now[0] = 10.1  # reset timeout elapses
+    assert check()  # half-open probe succeeds -> closed
+    assert _tcount(brk, "half_open") == 1
+    assert _tcount(brk, "close") == 1
+    assert brk.state == "closed"
+    hits_after = FI.hits("device.dispatch")
+    assert check()
+    assert FI.hits("device.dispatch") == hits_after + 1  # device live
+
+
+def test_slow_backend_trips_breaker_via_deadline(committee, monkeypatch):
+    """A backend that only STALLS (no exception) trips the breaker
+    through the dispatch deadline; results stay correct throughout."""
+    keys, serialized = committee
+    brk = CircuitBreaker("chaos-slow", failure_threshold=3,
+                         reset_timeout_s=60.0)
+    monkeypatch.setattr(DV, "BREAKER", brk)
+    DV.set_dispatch_deadline(0.01)
+    FI.arm("device.dispatch", delay_s=0.05)  # 5x over budget
+    ctx = EpochContext(serialized)
+    payload = b"chaos-deadline-payload-32-bytes!"
+    sigs = [keys[i].sign_hash(payload) for i in range(3)]
+    agg = B.aggregate_sigs(sigs)
+    for _ in range(3):
+        assert DV.agg_verify_on_device(
+            ctx.committee_table(), [1, 1, 1, 0], payload, agg.point
+        )
+    assert brk.state == "open"
+    assert _tcount(brk, "open") == 1
+
+
+def test_breaker_transitions_visible_in_prometheus_exposition(
+        committee, _clean_faults_and_breaker):
+    from harmony_tpu.metrics import Registry
+
+    keys, serialized = committee
+    brk = _clean_faults_and_breaker
+    FI.arm("device.dispatch", exc=RuntimeError)
+    ctx = EpochContext(serialized)
+    payload = b"chaos-metrics-payload-32-bytes!!"
+    sigs = [keys[i].sign_hash(payload) for i in range(3)]
+    agg = B.aggregate_sigs(sigs)
+    for _ in range(3):
+        DV.agg_verify_on_device(
+            ctx.committee_table(), [1, 1, 1, 0], payload, agg.point
+        )
+    text = Registry().expose()
+    assert ("harmony_resilience_events_total"
+            f'{{breaker="{brk.name}",event="open"}} 1') in text
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+
+class _BlackHole:
+    """A peer that accepts the TCP dial and then says nothing."""
+
+    def __init__(self):
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self.conns = []
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            self.conns.append(conn)
+
+    def close(self):
+        for s in [self.srv] + self.conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _chain_with_blocks(n=3):
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.core.types import Transaction
+    from harmony_tpu.node.worker import Worker
+
+    genesis, keys, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(2, 0, chain.state)
+    worker = Worker(chain, pool)
+    to = b"\x07" * 20
+    for i in range(n):
+        tx = Transaction(
+            nonce=i, gas_price=1, gas_limit=25_000, shard_id=0,
+            to_shard=0, to=to, value=50 + i,
+        ).sign(keys[0], 2)
+        pool.add(tx)
+        block = worker.propose_block(view_id=i + 1)
+        chain.insert_chain([block], verify_seals=False)
+        chain.write_commit_sig(block.block_num, b"\x01" * 96 + b"\x0f")
+        pool.drop_applied()
+    return chain, genesis
+
+
+def test_sync_completes_with_blackholed_peer():
+    """Satellite: a peer that times out mid-stage is excluded and the
+    stage completes from the remaining peers — one dead peer costs one
+    deadline, not a stall."""
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+    from harmony_tpu.sync import Downloader
+
+    serving, genesis = _chain_with_blocks(4)
+    srv = SyncServer(serving)
+    hole = _BlackHole()
+    try:
+        bad = SyncClient(hole.port, timeout=5.0)  # deadline must win
+        good = SyncClient(srv.port)
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(fresh, [bad, good], batch=2,
+                        verify_seals=False, request_deadline_s=0.3)
+        t0 = time.monotonic()
+        res = dl.sync_once()
+        elapsed = time.monotonic() - t0
+        assert fresh.head_number == 4 and not res.errors
+        assert id(bad) in dl._excluded  # black-holed peer benched
+        # one deadline for the dead peer, not one per request/window
+        assert elapsed < 5.0
+        bad.close()
+        good.close()
+    finally:
+        hole.close()
+        srv.close()
+
+
+def test_fbft_finalizes_block_while_backend_flaps_and_peer_blackholed(
+        committee):
+    """THE acceptance chaos scenario: device backend raising on every
+    other dispatch AND a black-holed sync peer, simultaneously — the
+    FBFT round still reaches a committed quorum proof that every
+    validator accepts (via the reference fallback), the downloader
+    still syncs the committed chain, and the degradation is visible in
+    metrics (ref_fallback > 0)."""
+    from harmony_tpu.consensus import fbft as FB
+    from harmony_tpu.consensus import quorum as Q
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+    from harmony_tpu.ref.keccak import keccak256
+    from harmony_tpu.sync import Downloader
+
+    keys, serialized = committee
+    FI.arm("device.dispatch", exc=RuntimeError, every=2)
+    fallback_before = DV.COUNTERS["ref_fallback"]
+
+    cfg = FB.RoundConfig(committee=serialized, block_num=9, view_id=1)
+    leader = FB.Leader(
+        PrivateKeys.from_keys([keys[0]]), cfg,
+        Q.Decider(Q.Policy.UNIFORM, serialized),
+    )
+    validators = [
+        FB.Validator(
+            PrivateKeys.from_keys([k]), cfg,
+            Q.Decider(Q.Policy.UNIFORM, serialized),
+        )
+        for k in keys[1:]
+    ]
+    block = b"chaos block body"
+    block_hash = keccak256(block)
+
+    announce = leader.announce(block_hash, block)
+    prepares = [v.on_announce(announce) for v in validators]
+    for p in prepares:
+        assert leader.on_prepare(p)  # vote checks survive the flapping
+    prepared = leader.try_prepared(block_hash)
+    assert prepared is not None
+
+    commits = [v.on_prepared(prepared) for v in validators]
+    assert all(c is not None for c in commits)  # proofs verified
+    for c in commits:
+        assert leader.on_commit(c)
+    committed = leader.try_committed(block_hash)
+    assert committed is not None  # the block FINALIZED
+
+    # every validator accepts the committed proof while flapping
+    assert all(v.on_committed(committed) for v in validators)
+    assert DV.COUNTERS["ref_fallback"] > fallback_before
+    assert FI.hits("device.dispatch") > 0
+
+    # ... and the sync layer rides out its black-holed peer in the
+    # same chaotic process
+    serving, genesis = _chain_with_blocks(3)
+    srv = SyncServer(serving)
+    hole = _BlackHole()
+    try:
+        bad = SyncClient(hole.port, timeout=5.0)
+        good = SyncClient(srv.port)
+        fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(fresh, [bad, good], batch=2,
+                        verify_seals=False, request_deadline_s=0.3)
+        res = dl.sync_once()
+        assert fresh.head_number == 3 and not res.errors
+        assert id(bad) in dl._excluded
+        bad.close()
+        good.close()
+    finally:
+        hole.close()
+        srv.close()

@@ -50,7 +50,7 @@ def _expected(path: Path) -> set:
 
 @pytest.mark.parametrize("name", [
     "gl01_cases.py", "gl02_cases.py", "gl03_cases.py", "gl04_cases.py",
-    "gl05_cases.py", "gl06_cases.py", "gl07_cases.py",
+    "gl05_cases.py", "gl06_cases.py", "gl07_cases.py", "gl08_cases.py",
 ])
 def test_fixture_exact_lines(name):
     """Each rule family flags exactly the tagged lines — no more, no

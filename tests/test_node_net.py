@@ -179,8 +179,8 @@ def test_fast_sync_rejects_forged_receipts():
     srv = SyncServer(serving)
 
     class ForgingClient(SyncClient):
-        def get_receipts(self, start, count):
-            per_block = super().get_receipts(start, count)
+        def get_receipts(self, start, count, deadline=None):
+            per_block = super().get_receipts(start, count, deadline)
             for receipts in per_block:
                 for r in receipts:
                     r.status = 0  # flip success -> failure
@@ -213,7 +213,7 @@ def test_fast_sync_rotates_on_non_advancing_account_pages():
     srv = SyncServer(serving)
 
     class LoopingClient(SyncClient):
-        def get_account_range(self, num, start):
+        def get_account_range(self, num, start, deadline=None):
             page = super().get_account_range(num, b"")
             return page  # always the FIRST page: start never advances
 

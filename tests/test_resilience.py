@@ -1,0 +1,478 @@
+"""Resilience layer: deadlines, retry/backoff, circuit breaker, the
+fault-injection registry, and the sidecar client's failure contract
+(fail closed on desync, reconnect with committee replay).
+
+Every scenario here is DETERMINISTIC: jitter is hashed, faults are
+counted, clocks are injected — a failure replays bit-for-bit.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from harmony_tpu import faultinject as FI
+from harmony_tpu.resilience import (
+    TRANSITIONS,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
+from harmony_tpu.sidecar import protocol as P
+from harmony_tpu.sidecar.client import SidecarClient, SidecarUnavailable
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset()
+    yield
+    FI.reset()
+
+
+# -- Deadline ----------------------------------------------------------------
+
+
+def test_deadline_budget_and_bound():
+    dl = Deadline.after(10.0)
+    rem = dl.remaining()
+    assert 9.0 < rem <= 10.0
+    assert not dl.expired()
+    assert dl.bound(3.0) == 3.0  # per-step timeout tighter
+    assert dl.bound(None) == pytest.approx(rem, abs=0.5)
+    dl.check("op")  # no raise
+
+    gone = Deadline.after(0.0)
+    assert gone.expired()
+    assert gone.bound(3.0) == 0.0
+    with pytest.raises(DeadlineExceeded):
+        gone.check("op")
+
+
+def test_deadline_none_is_unbounded():
+    dl = Deadline.none()
+    assert dl.remaining() is None
+    assert not dl.expired()
+    assert dl.bound(2.5) == 2.5
+    assert dl.bound(None) is None
+    dl.check()
+
+
+def test_deadline_exceeded_is_oserror():
+    # socket-style except blocks must catch budget exhaustion for free
+    assert issubclass(DeadlineExceeded, TimeoutError)
+    assert issubclass(DeadlineExceeded, OSError)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+
+def test_retry_delays_are_deterministic_and_bounded():
+    p = RetryPolicy(attempts=5, base_delay_s=0.1, multiplier=2.0,
+                    max_delay_s=0.5, jitter=0.5, seed=7)
+    a = [p.delay(i, key="x") for i in range(1, 5)]
+    b = [p.delay(i, key="x") for i in range(1, 5)]
+    assert a == b  # same seed/key/attempt -> same schedule
+    assert a != [p.delay(i, key="y") for i in range(1, 5)]  # keyed
+    for i, d in enumerate(a, start=1):
+        cap = min(0.5, 0.1 * 2.0 ** (i - 1))
+        assert 0.5 * cap <= d <= cap  # jitter shrinks, never grows
+
+
+def test_retry_run_retries_then_raises():
+    calls, slept = [], []
+    p = RetryPolicy(attempts=3, base_delay_s=0.01)
+
+    def fails():
+        calls.append(1)
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        p.run(fails, retry_on=(ValueError,), sleep=slept.append)
+    assert len(calls) == 3 and len(slept) == 2
+
+    calls.clear()
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ValueError("once")
+        return "ok"
+
+    assert p.run(flaky, retry_on=(ValueError,),
+                 sleep=slept.append) == "ok"
+    assert len(calls) == 2
+
+
+def test_retry_run_respects_deadline():
+    """A backoff the budget cannot cover is skipped: the last error
+    surfaces immediately instead of sleeping past the deadline."""
+    p = RetryPolicy(attempts=10, base_delay_s=5.0, max_delay_s=5.0)
+    calls, slept = [], []
+
+    def fails():
+        calls.append(1)
+        raise ValueError("nope")
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        p.run(fails, retry_on=(ValueError,),
+              deadline=Deadline.after(0.2), sleep=slept.append)
+    assert time.monotonic() - t0 < 1.0
+    assert len(calls) == 1 and slept == []  # no 5 s sleep attempted
+
+    # an already-dead budget never even tries
+    with pytest.raises(DeadlineExceeded):
+        p.run(fails, retry_on=(ValueError,),
+              deadline=Deadline.after(0.0))
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+
+def test_breaker_full_lifecycle_with_metrics():
+    now = [0.0]
+    brk = CircuitBreaker("t-lifecycle", failure_threshold=3,
+                         reset_timeout_s=10.0, clock=lambda: now[0])
+    base = {k: TRANSITIONS[f"t-lifecycle:{k}"]
+            for k in ("open", "half_open", "close", "rejected")}
+
+    def delta(k):
+        return TRANSITIONS[f"t-lifecycle:{k}"] - base[k]
+
+    assert brk.state == "closed" and brk.allow()
+    brk.record_failure()
+    brk.record_failure()
+    assert brk.state == "closed"  # below threshold
+    brk.record_success()  # success resets the consecutive count
+    brk.record_failure()
+    brk.record_failure()
+    assert brk.state == "closed"
+    brk.record_failure()  # third consecutive: trip
+    assert brk.state == "open" and delta("open") == 1
+    assert not brk.allow() and delta("rejected") >= 1
+
+    now[0] = 10.1  # reset timeout elapses -> half-open
+    assert brk.allow()  # the single probe
+    assert delta("half_open") == 1
+    assert not brk.allow()  # second concurrent probe rejected
+    brk.record_failure()  # probe failed -> re-open
+    assert brk.state == "open" and delta("open") == 2
+
+    now[0] = 20.3
+    assert brk.allow()
+    brk.record_success()  # probe succeeded -> closed
+    assert brk.state == "closed" and delta("close") == 1
+    assert brk.allow()
+
+
+# -- faultinject -------------------------------------------------------------
+
+
+def test_faultinject_disarmed_is_noop():
+    FI.fire("some.point")  # nothing armed: no raise
+    assert FI.garble("some.point", b"abc") == b"abc"
+
+
+def test_faultinject_counting_and_selectors():
+    FI.arm("p", exc=RuntimeError, every=2, after=1, times=2)
+    # hit 1 skipped (after=1); then every other: hits 2, 4 fire; times=2
+    fired = []
+    for i in range(1, 8):
+        try:
+            FI.fire("p")
+        except RuntimeError:
+            fired.append(i)
+    assert fired == [2, 4]
+    assert FI.hits("p") == 7
+
+
+def test_faultinject_key_matching():
+    FI.arm("peer", exc=ConnectionResetError, key="10.0.0.2:99")
+    FI.fire("peer", key="10.0.0.1:99")  # other peer: clean
+    with pytest.raises(ConnectionResetError):
+        FI.fire("peer", key="10.0.0.2:99")
+
+
+def test_faultinject_delay_and_garble_deterministic():
+    FI.arm("slow", delay_s=0.05)
+    t0 = time.monotonic()
+    FI.fire("slow")
+    assert time.monotonic() - t0 >= 0.05
+
+    FI.arm("wire", garble=True)
+    FI.set_seed(42)
+    data = bytes(range(32))
+    g1 = FI.garble("wire", data)
+    assert g1 != data and len(g1) == len(data)
+    FI.reset()
+    FI.arm("wire", garble=True)
+    FI.set_seed(42)
+    assert FI.garble("wire", data) == g1  # seeded: replays exactly
+
+
+# -- SidecarClient failure contract ------------------------------------------
+
+
+class _HungServer:
+    """Accepts connections, reads frames, never responds — the wedged
+    sidecar the r5 client hung on forever."""
+
+    def __init__(self):
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.address = self.srv.getsockname()
+        self.conns = []
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            self.conns.append(conn)
+
+    def kill_conns(self):
+        for c in self.conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self.kill_conns()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+def _fast_client(address, call_timeout=0.4):
+    return SidecarClient(
+        address, connect_timeout=1.0, call_timeout=call_timeout,
+        retry=RetryPolicy(attempts=2, base_delay_s=0.01,
+                          max_delay_s=0.05),
+    )
+
+
+def test_sidecar_hung_server_times_out_within_deadline():
+    srv = _HungServer()
+    try:
+        c = _fast_client(srv.address)
+        t0 = time.monotonic()
+        with pytest.raises(SidecarUnavailable):
+            c.ping()
+        assert time.monotonic() - t0 < 2.0  # bounded, not forever
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_sidecar_killed_mid_request_fails_closed_fast():
+    """A connection dying under an in-flight call surfaces the typed
+    error IMMEDIATELY (EOF), long before the call timeout."""
+    srv = _HungServer()
+    try:
+        c = SidecarClient(
+            srv.address, connect_timeout=1.0, call_timeout=5.0,
+            retry=RetryPolicy(attempts=1),  # surface the first EOF
+        )
+        errs = []
+
+        def call():
+            try:
+                c.ping()
+            except SidecarUnavailable as e:
+                errs.append(e)
+
+        t = threading.Thread(target=call)
+        t0 = time.monotonic()
+        t.start()
+        time.sleep(0.15)  # let the request get in flight
+        srv.kill_conns()  # sidecar dies mid-request
+        t.join(timeout=3.0)
+        assert not t.is_alive()
+        assert errs and time.monotonic() - t0 < 3.0
+        c.close()
+    finally:
+        srv.close()
+
+
+class _DesyncServer:
+    """Replies with a MISMATCHED request id — the stream-desync bug
+    class that used to poison every later call."""
+
+    def __init__(self):
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.address = self.srv.getsockname()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                frame = P.read_frame(conn)
+                if frame is None:
+                    return
+                mtype, rid, _ = frame
+                conn.sendall(P.pack_frame(
+                    mtype | P.RESP_FLAG, rid + 1000,
+                    bytes([P.STATUS_OK]) + b"\x01\x00",
+                ))
+        except (ValueError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+def test_sidecar_desynced_reply_raises_typed_error():
+    srv = _DesyncServer()
+    try:
+        c = _fast_client(srv.address)
+        with pytest.raises(SidecarUnavailable):
+            c.ping()
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_sidecar_reconnects_and_replays_committee():
+    """THE acceptance scenario: server dies after the committee upload;
+    the next call fails typed and bounded; a replacement server on the
+    same address serves agg_verify WITHOUT a fresh set_committee —
+    the client replayed it on reconnect."""
+    from harmony_tpu.consensus.mask import Mask
+    from harmony_tpu.ref import bls as RB
+    from harmony_tpu.sidecar.server import SidecarServer
+
+    msg = b"0123456789abcdef0123456789abcdef"
+    sks = [RB.keygen(bytes([40 + i])) for i in range(4)]
+    pks = [RB.pubkey(sk) for sk in sks]
+    sigs = [RB.sign(sk, msg) for sk in sks]
+    agg = RB.aggregate_sigs([sigs[0], sigs[2], sigs[3]])
+    mask = Mask(pks)
+    for i in (0, 2, 3):
+        mask.set_bit(i, True)
+
+    srv = SidecarServer().start()
+    host, port = srv.address
+    c = _fast_client(srv.address, call_timeout=5.0)
+    c.set_committee(9, 1, [RB.pubkey_to_bytes(p) for p in pks])
+    assert c.agg_verify(9, 1, msg, mask.mask_bytes(),
+                        RB.sig_to_bytes(agg))
+
+    srv.stop()
+    t0 = time.monotonic()
+    with pytest.raises(SidecarUnavailable):
+        c.ping()
+    assert time.monotonic() - t0 < 4.0
+
+    # replacement sidecar on the SAME address knows NO committees...
+    srv2 = SidecarServer(host=host, port=port).start()
+    try:
+        # ...yet agg_verify succeeds: the client replays (9, 1) on
+        # reconnect before letting the request through
+        assert c.agg_verify(9, 1, msg, mask.mask_bytes(),
+                            RB.sig_to_bytes(agg))
+        # and a wrong bitmap still fails THROUGH the replayed state
+        mask.set_bit(1, True)
+        assert not c.agg_verify(9, 1, msg, mask.mask_bytes(),
+                                RB.sig_to_bytes(agg))
+        c.close()
+    finally:
+        srv2.stop()
+
+
+def test_sidecar_injected_garbage_frame_drops_connection():
+    """A garbage frame (via the sidecar.frame injection point) kills
+    the connection — fail closed — and the next call heals by
+    redialing."""
+    from harmony_tpu.sidecar.server import SidecarServer
+
+    srv = SidecarServer().start()
+    try:
+        c = _fast_client(srv.address, call_timeout=1.0)
+        FI.arm("sidecar.frame", exc=ValueError, every=1, times=1)
+        # the injected fault may land on this call (dropped + retried
+        # on a fresh connection) — the call must still come back typed
+        try:
+            c.ping()
+        except SidecarUnavailable:
+            pass
+        FI.reset()
+        assert c.ping() == P.VERSION  # healed
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- webhooks bounded retry --------------------------------------------------
+
+
+def test_webhook_retries_through_transient_failures():
+    import http.server
+
+    from harmony_tpu.webhooks import http_post_hook
+
+    got = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            got.append(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}/hook"
+    try:
+        # two injected failures, three attempts: delivery must land
+        FI.arm("webhook.post", exc=ConnectionResetError, times=2)
+        hook = http_post_hook(
+            url, timeout=2.0,
+            retry=RetryPolicy(attempts=3, base_delay_s=0.01,
+                              max_delay_s=0.05),
+        )
+        hook({"event": "double_sign"})
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got and b"double_sign" in got[0]
+
+        # a permanently failing endpoint: logged drop, no delivery,
+        # and the hook thread terminates
+        FI.reset()
+        FI.arm("webhook.post", exc=ConnectionResetError)
+        before = len(got)
+        hook({"event": "view_change"})
+        time.sleep(0.3)
+        assert len(got) == before
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -1,14 +1,18 @@
 #!/usr/bin/env bash
 # Pre-commit gate for harmony-tpu.
 #
-# Two stages, fail-fast:
-#   1. graftlint — whole-program static analysis (GL01-GL07) against
+# Three stages, fail-fast:
+#   1. graftlint — whole-program static analysis (GL01-GL08) against
 #      the committed baseline.  Exit-code contract (stable for hooks):
 #      0 clean, 1 new violations, 2 internal linter error — any
 #      non-zero stops this script with the same code.
 #   2. tier-1 smoke subset — the fast, pure-CPU slices that catch the
 #      classes of regression this repo's PRs most often introduce
 #      (linter self-tests, device-path wiring, thread-safety, codecs).
+#   3. chaos smoke — the fault-injection tier (resilience primitives +
+#      flapping-backend/black-holed-peer scenarios).  Deterministic by
+#      construction: faults are counted, jitter is hashed, breaker
+#      clocks are injected — no RNG seed to pin.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -25,5 +29,11 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   tests/test_concurrency.py \
   tests/test_rlp_trie.py \
   tests/test_config.py
+
+echo "== chaos smoke: fault-injection tier =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_resilience.py \
+  tests/test_chaos.py
 
 echo "check.sh: OK"

@@ -35,8 +35,10 @@ RULES = {
     "GL05": "lock order: no cycles in the whole-program lock graph",
     "GL06": "no blocking I/O / joins / device work under a held lock",
     "GL07": "hot path: no per-item device->host syncs in loops",
+    "GL08": "bounded blocking: socket connect/recv and urlopen must "
+            "have a timeout ever set",
 }
-INTERPROC_RULES = {"GL05", "GL06", "GL07"}
+INTERPROC_RULES = {"GL05", "GL06", "GL07", "GL08"}
 
 # -- rule scoping over harmony_tpu/ -----------------------------------------
 
@@ -192,6 +194,8 @@ def _interproc_findings(sources: dict, supps: dict,
         raw += IP.gl06_findings(prog)
     if "GL07" in wanted:
         raw += IP.gl07_findings(prog)
+    if "GL08" in wanted:
+        raw += IP.gl08_findings(prog)
     findings = []
     for sf in raw:
         if not _rule_applies(sf.rule, sf.relpath):

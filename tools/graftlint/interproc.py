@@ -773,6 +773,224 @@ def gl07_findings(prog: Program) -> list[SiteFinding]:
 
 
 # ---------------------------------------------------------------------------
+# GL08 — unbounded blocking calls (no timeout ever set)
+
+_GL08_BLOCKING = {"connect", "recv", "recv_into"}
+_GL08_URLOPEN = {"urlopen", "urllib.request.urlopen", "request.urlopen"}
+
+
+def _is_none_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _gl08_sock_ctor(node: ast.AST) -> str | None:
+    """'plain' for socket.socket(...), 'bounded'/'unbounded' for
+    create_connection with/without a timeout, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    head = dotted_name(node.func)
+    if head in ("socket.socket", "socket"):
+        return "plain"
+    if head and head.split(".")[-1] == "create_connection":
+        has_timeout = len(node.args) >= 2 or any(
+            k.arg == "timeout" and not _is_none_const(k.value)
+            for k in node.keywords
+        )
+        return "bounded" if has_timeout else "unbounded"
+    return None
+
+
+def _gl08_settimeout_target(node: ast.AST) -> ast.AST | None:
+    """The receiver of a real ``settimeout`` call (None arg = blocking
+    mode, which does NOT count as a timeout)."""
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "settimeout"
+            and node.args and not _is_none_const(node.args[0])):
+        return node.func.value
+    return None
+
+
+def _gl08_class_attrs(cls_node: ast.ClassDef) -> tuple[set, set]:
+    """(created socket attrs, timeout-bounded attrs) for ``self.X``
+    sockets, scanned across EVERY method — a timeout set in __init__
+    bounds the recv in a sibling method (that cross-method view is why
+    this rule lives in the whole-program pass)."""
+    created: set[str] = set()
+    bounded: set[str] = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign):
+            kind = _gl08_sock_ctor(node.value)
+            if kind:
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a:
+                        created.add(a)
+                        if kind == "bounded":
+                            bounded.add(a)
+        else:
+            tgt = _gl08_settimeout_target(node)
+            if tgt is not None:
+                a = _self_attr(tgt)
+                if a:
+                    bounded.add(a)
+    return created, bounded
+
+
+def _gl08_local_sockets(fn: ast.AST) -> tuple[set, set]:
+    """(created local socket names, timeout-bounded names) within one
+    function body."""
+    created: set[str] = set()
+    bounded: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            kind = _gl08_sock_ctor(node.value)
+            if kind:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        created.add(tgt.id)
+                        if kind == "bounded":
+                            bounded.add(tgt.id)
+        else:
+            tgt = _gl08_settimeout_target(node)
+            if isinstance(tgt, ast.Name):
+                bounded.add(tgt.id)
+    return created, bounded
+
+
+def _gl08_param_flow(prog: Program) -> tuple[dict, dict]:
+    """(blocking params, params list) per fid.  A param index is
+    *blocking* when the function recv/connects on it (without setting
+    a timeout itself) or passes it positionally into a callee whose
+    matching param is blocking — the transitive closure that makes
+    ``read_frame(self._sock)`` light up at the call site."""
+    params_of: dict[str, list] = {}
+    blocking: dict[str, set] = {}
+    edges: list[tuple] = []  # (caller fid, caller idx, callee fid, callee idx)
+    for fid, fi in prog.funcs.items():
+        fn = fi.node
+        names = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+        params_of[fid] = names
+        blocking[fid] = set()
+    for fid in sorted(prog.funcs):
+        fi = prog.funcs[fid]
+        fn = fi.node
+        names = params_of[fid]
+        mi = prog.modules[fi.relpath]
+        bounded: set[int] = set()
+        for node in ast.walk(fn):
+            tgt = _gl08_settimeout_target(node)
+            if isinstance(tgt, ast.Name) and tgt.id in names:
+                bounded.add(names.index(tgt.id))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GL08_BLOCKING
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in names):
+                i = names.index(node.func.value.id)
+                if i not in bounded:
+                    blocking[fid].add(i)
+            ref = prog._call_ref(mi, fi, node)
+            if ref is None:
+                continue
+            callees = [c for c in prog.resolve(fi, ref)
+                       if c in prog.funcs]
+            for callee in callees:
+                offset = 1 if prog.funcs[callee].cls else 0
+                for ai, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and arg.id in names:
+                        i = names.index(arg.id)
+                        if i not in bounded:
+                            edges.append((fid, i, callee, ai + offset))
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for caller, ci, callee, pi in edges:
+            if pi in blocking.get(callee, ()) \
+                    and ci not in blocking[caller]:
+                blocking[caller].add(ci)
+                changed = True
+    return blocking, params_of
+
+
+def gl08_findings(prog: Program) -> list[SiteFinding]:
+    out = []
+    param_blocking, params_of = _gl08_param_flow(prog)
+    attr_info: dict[tuple, tuple] = {}
+    for relpath, mi in prog.modules.items():
+        for node in mi.tree.body:
+            if isinstance(node, ast.ClassDef):
+                attr_info[(relpath, node.name)] = _gl08_class_attrs(node)
+    for fid in sorted(prog.funcs):
+        fi = prog.funcs[fid]
+        fn = fi.node
+        mi = prog.modules[fi.relpath]
+        created_a, bounded_a = attr_info.get(
+            (fi.relpath, fi.cls), (set(), set()))
+        created_l, bounded_l = _gl08_local_sockets(fn)
+
+        def render_unbounded(expr: ast.AST) -> str | None:
+            a = _self_attr(expr)
+            if a is not None:
+                if a in created_a and a not in bounded_a:
+                    return f"self.{a}"
+                return None
+            if isinstance(expr, ast.Name):
+                if expr.id in created_l and expr.id not in bounded_l:
+                    return expr.id
+            return None
+
+        def emit(node, message):
+            out.append(SiteFinding(
+                fi.relpath, "GL08", node.lineno, node.col_offset,
+                message, fi.qualname))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            head = dotted_name(node.func)
+            if head in _GL08_URLOPEN:
+                has_timeout = len(node.args) >= 3 or any(
+                    k.arg == "timeout" and not _is_none_const(k.value)
+                    for k in node.keywords)
+                if not has_timeout:
+                    emit(node, "urlopen without a timeout (hangs "
+                               "forever on a stalled endpoint)")
+                continue
+            if _gl08_sock_ctor(node) == "unbounded":
+                emit(node, "create_connection without a timeout "
+                           "(blocking dial can hang forever)")
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GL08_BLOCKING):
+                name = render_unbounded(node.func.value)
+                if name:
+                    emit(node, f"socket {node.func.attr} on {name} "
+                               "with no timeout ever set")
+                continue
+            ref = prog._call_ref(mi, fi, node)
+            if ref is None:
+                continue
+            for callee in sorted(prog.resolve(fi, ref)):
+                cfi = prog.funcs.get(callee)
+                if cfi is None:
+                    continue
+                offset = 1 if cfi.cls else 0
+                blocked = param_blocking.get(callee, set())
+                for ai, arg in enumerate(node.args):
+                    name = render_unbounded(arg)
+                    if name and (ai + offset) in blocked:
+                        emit(node, f"timeout-less socket {name} passed "
+                                   f"into {_short(callee)} (reaches "
+                                   "blocking socket I/O)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # DOT dump
 
 
