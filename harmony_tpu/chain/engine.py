@@ -242,6 +242,18 @@ class Engine:
         )
         if len(flags) != len(items):
             raise ValueError("is_staking list length != items length")
+        if self.backend is not None:
+            # out-of-process verification service: the sidecar holds
+            # the committee device-resident, so each check ships only
+            # O(bitmap + 96 B); until the protocol grows a batched
+            # AGG_VERIFY this loops the per-header path (which also
+            # carries the verified-sig cache and trace propagation).
+            # Before this route the insert/replay path silently IGNORED
+            # a wired backend and verified in-process.
+            return [
+                self.verify_header_signature(h, s, b, flags[i])
+                for i, (h, s, b) in enumerate(items)
+            ]
         results = [False] * len(items)
         # survivors grouped by committee context: each group runs as one
         # fused device batch (bitmaps + hashed payloads + sigs in, bools

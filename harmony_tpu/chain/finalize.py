@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from .. import trace
 from ..consensus.mask import bits_from_bytes
 from ..numeric import Dec, new_dec
 from ..staking.availability import SIGNING_THRESHOLD
@@ -61,8 +62,10 @@ class Finalizer:
             bits = bits_from_bytes(prev_bitmap, len(keys))
         except ValueError:
             return
-        self._increment_counters(state, com, bits)
-        self._accumulate_rewards(state, com, bits)
+        with trace.span("chain.finalize_block", component="chain",
+                        shard=shard_id, slots=len(com.slots)):
+            self._increment_counters(state, com, bits)
+            self._accumulate_rewards(state, com, bits)
 
     def _slot_validator(self, state, slot):
         if slot.effective_stake is None:
@@ -155,6 +158,10 @@ class Finalizer:
     def elect(self, state, epoch: int) -> ShardState:
         """Build next epoch's committees from on-chain validators
         (assignment.go:319-388 eposStakedCommittee)."""
+        with trace.span("chain.elect", component="chain", epoch=epoch):
+            return self._elect(state, epoch)
+
+    def _elect(self, state, epoch: int) -> ShardState:
         orders = {}
         for addr in state.validator_addresses():
             w = state.validator(addr)

@@ -82,6 +82,15 @@ DEFAULTS = {
     "explorer_port": None,
     "rosetta_port": None,
     "ws_port": None,  # WebSocket JSON-RPC + eth_subscribe push
+    # round tracing + flight recorder (harmony_tpu/trace.py): OFF by
+    # default (disabled cost is one comparison per instrumented site);
+    # when on, /debug/trace serves the round timelines and anomalies
+    # (breaker open, view change, sidecar desync, round > trace_slo)
+    # dump correlated snapshots to trace_dir
+    "trace": False,
+    "trace_sample": 1.0,   # root-span sampling rate [0, 1]
+    "trace_slo": None,     # round-latency SLO seconds (None = off)
+    "trace_dir": None,     # dump dir ($HARMONY_TPU_TRACE_DIR/<tmp>)
 }
 
 
@@ -240,6 +249,20 @@ def build_node(cfg: dict):
     """Wire every subsystem; returns (node, services, registry)."""
     os.makedirs(cfg["datadir"], exist_ok=True)
 
+    if cfg.get("trace"):
+        from . import trace as TR
+
+        # explicit None checks: --trace-sample 0 is a valid rate
+        # ("arm the recorder, sample no local roots") and must not be
+        # swallowed by a falsy-or into the 1.0 default
+        sample = cfg.get("trace_sample")
+        TR.configure(
+            enabled=True,
+            sample_rate=None if sample is None else float(sample),
+            round_slo_s=cfg.get("trace_slo"),
+            dump_dir=cfg.get("trace_dir"),
+        )
+
     genesis, dev_bls = _open_genesis(cfg)
     db = _open_db(cfg)
 
@@ -340,6 +363,10 @@ def build_node(cfg: dict):
     if reg_epoch_chain is not None:
         reg.set("beaconchain", reg_epoch_chain)
     reg.set("shard_count", int(cfg.get("shard_count") or 1))
+    # the metrics registry must exist BEFORE the Node: its constructor
+    # wires the per-round latency histogram from registry.get("metrics")
+    metrics_reg = MetricsRegistry()
+    reg.set("metrics", metrics_reg)
     node = Node(reg, keys, network=cfg["network"])
     hmy = Harmony(chain, pool, node)
 
@@ -360,8 +387,6 @@ def build_node(cfg: dict):
             _CallbackService(ws.start, ws.stop),
         )
 
-    metrics_reg = MetricsRegistry()
-    reg.set("metrics", metrics_reg)
     metrics = MetricsServer(metrics_reg, port=cfg["metrics_port"])
     manager.register(
         ServiceType.PROMETHEUS,
@@ -514,6 +539,17 @@ def main(argv=None):
     p.add_argument("--log-level", dest="log_level",
                    choices=["debug", "info", "warn", "error"])
     p.add_argument("--log-path", dest="log_path")
+    p.add_argument("--trace", dest="trace", action="store_const",
+                   const=True, default=None,
+                   help="arm round tracing + the flight recorder "
+                        "(/debug/trace on the metrics port)")
+    p.add_argument("--trace-sample", type=float, dest="trace_sample",
+                   help="root-span sampling rate in [0,1] (default 1)")
+    p.add_argument("--trace-slo", type=float, dest="trace_slo",
+                   help="round-latency SLO seconds; a slower round "
+                        "dumps a flight-recorder snapshot")
+    p.add_argument("--trace-dir", dest="trace_dir",
+                   help="flight-recorder dump directory")
     p.add_argument("--device-verify", dest="device_verify",
                    action="store_const", const=True, default=None,
                    help="force the TPU verification path")

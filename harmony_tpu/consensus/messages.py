@@ -52,6 +52,12 @@ class FBFTMessage:
     # senderKeySanityChecks/verify); without it any peer could
     # impersonate the leader's ANNOUNCE/PREPARED/COMMITTED
     sender_sig: bytes = b""
+    # OPTIONAL trace context (harmony_tpu.trace traceparent bytes):
+    # transport metadata, deliberately OUTSIDE the signable encoding
+    # and the dedup key — a relay stamping its own context must not
+    # invalidate the sender signature, and a forged context can at
+    # worst mislabel a span, never affect consensus
+    trace_ctx: bytes = b""
 
     def key(self):
         """Dedup/storage key (reference: consensus/fbft_log.go:128-143)."""
@@ -187,9 +193,13 @@ def verify_sender_sig(msg: FBFTMessage) -> bool:
 def encode_message(msg: FBFTMessage) -> bytes:
     """Canonical wire form (the payload inside the gossip envelope —
     the reference uses protobuf harmonymessage.pb.go; this framework
-    uses its fixed little-endian layout)."""
+    uses its fixed little-endian layout).  The trace context is an
+    optional unsigned trailer: absent entirely when empty, so traced
+    and untraced nodes interoperate."""
     out = bytearray(signable_bytes(msg))
     out += len(msg.sender_sig).to_bytes(4, "little") + msg.sender_sig
+    if msg.trace_ctx:
+        out += len(msg.trace_ctx).to_bytes(2, "little") + msg.trace_ctx
     return bytes(out)
 
 
@@ -215,10 +225,17 @@ def decode_message(data: bytes) -> FBFTMessage:
     block = bytes(view[off:off + blen]); off += blen
     slen = int.from_bytes(view[off:off + 4], "little"); off += 4
     sender_sig = bytes(view[off:off + slen]); off += slen
+    trace_ctx = b""
     if off != len(view):
-        raise ValueError("trailing bytes in message")
+        # optional trace-context trailer (u16 len + bytes)
+        if len(view) - off < 2:
+            raise ValueError("trailing bytes in message")
+        tlen = int.from_bytes(view[off:off + 2], "little"); off += 2
+        trace_ctx = bytes(view[off:off + tlen]); off += tlen
+        if off != len(view):
+            raise ValueError("trailing bytes in message")
     return FBFTMessage(
         msg_type=msg_type, view_id=view_id, block_num=block_num,
         block_hash=block_hash, sender_pubkeys=keys, payload=payload,
-        block=block, sender_sig=sender_sig,
+        block=block, sender_sig=sender_sig, trace_ctx=trace_ctx,
     )

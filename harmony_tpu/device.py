@@ -20,8 +20,9 @@ import threading
 import time
 
 from . import faultinject as FI
+from . import trace
 from .log import get_logger
-from .metrics import LockedCounters
+from .metrics import Gauge, Histogram, LockedCounters
 from .resilience import CircuitBreaker
 
 _log = get_logger("device")
@@ -33,6 +34,37 @@ _LOCK = threading.Lock()
 COUNTERS = LockedCounters(
     "verify", "agg_verify", "batch_verify", "ref_fallback"
 )
+
+# Observability singletons (exposed through metrics.Registry alongside
+# COUNTERS): per-dispatch latency, host<->device transfer bytes, and
+# the jit program-shape cache — was this dispatch's (kernel, bucket)
+# shape already compiled in-process, and how long did the compiling
+# first dispatch take?  All annotated onto the active trace span too,
+# so /debug/trace shows WHY one dispatch in a round cost 100x.
+DISPATCH_SECONDS = Histogram(
+    "harmony_device_dispatch_seconds",
+    "wall time of one breaker-guarded device dispatch",
+)
+TRANSFER = LockedCounters("h2d", "d2h")
+JIT = LockedCounters("hit", "miss")
+JIT_COMPILE_SECONDS = Gauge(
+    "harmony_device_jit_compile_seconds",
+    "wall time of the first (compiling) dispatch per program shape",
+)
+
+_SEEN_PROGRAMS: set = set()
+_SEEN_LOCK = threading.Lock()
+
+
+def _program_first_use(program: str) -> bool:
+    """True exactly once per program shape per process — the dispatch
+    that pays the JIT compile (or the twin's first wire-up)."""
+    with _SEEN_LOCK:
+        first = program not in _SEEN_PROGRAMS
+        if first:
+            _SEEN_PROGRAMS.add(program)
+    JIT.inc("miss" if first else "hit")
+    return first
 
 # The device-dispatch circuit breaker: a backend that keeps raising (a
 # wedged accelerator tunnel, a dying sidecar of the twin kernels, an
@@ -63,29 +95,39 @@ def _guarded(kind: str, dispatch, fallback):
     Raise -> breaker failure + reference fallback (transparent: the
     caller still gets a correct bool).  Deadline overrun -> breaker
     failure, device result kept.  Breaker OPEN -> fallback without
-    touching the device at all."""
+    touching the device at all.  The whole attempt (fallback included,
+    when one runs) is a ``device.dispatch`` trace span nested under
+    whatever consensus/sidecar span caused it."""
     if not BREAKER.allow():
         COUNTERS.inc("ref_fallback")
-        return fallback()
+        with trace.span("device.dispatch", component="device",
+                        kind=kind, outcome="breaker_open"):
+            return fallback()
     t0 = time.monotonic()
-    try:
-        FI.fire("device.dispatch")
-        out = dispatch()
-    except Exception as e:  # noqa: BLE001 — any backend failure
-        # degrades to the host path, never up into consensus
-        BREAKER.record_failure()
-        COUNTERS.inc("ref_fallback")
-        _log.warn("device dispatch failed; reference fallback",
-                  kind=kind, error=str(e))
-        return fallback()
-    if (DISPATCH_DEADLINE_S is not None
-            and time.monotonic() - t0 > DISPATCH_DEADLINE_S):
-        BREAKER.record_failure()
-        _log.warn("device dispatch exceeded deadline", kind=kind,
-                  budget_s=DISPATCH_DEADLINE_S)
-    else:
-        BREAKER.record_success()
-    return out
+    with trace.span("device.dispatch", component="device", kind=kind):
+        try:
+            FI.fire("device.dispatch")
+            out = dispatch()
+        except Exception as e:  # noqa: BLE001 — any backend failure
+            # degrades to the host path, never up into consensus
+            BREAKER.record_failure()
+            COUNTERS.inc("ref_fallback")
+            _log.warn("device dispatch failed; reference fallback",
+                      kind=kind, error=str(e))
+            trace.annotate(outcome="ref_fallback", error=str(e))
+            DISPATCH_SECONDS.observe(time.monotonic() - t0)
+            return fallback()
+        elapsed = time.monotonic() - t0
+        DISPATCH_SECONDS.observe(elapsed)
+        if (DISPATCH_DEADLINE_S is not None
+                and elapsed > DISPATCH_DEADLINE_S):
+            BREAKER.record_failure()
+            _log.warn("device dispatch exceeded deadline", kind=kind,
+                      budget_s=DISPATCH_DEADLINE_S)
+            trace.annotate(outcome="deadline_overrun")
+        else:
+            BREAKER.record_success()
+        return out
 
 # Committee tables are padded to one of these pinned sizes so every
 # epoch/committee shares a small set of compiled programs (pad keys are
@@ -130,6 +172,9 @@ class CommitteeTable:
 
         if self._dev is None:
             self._dev = jnp.asarray(self._np)
+            # the one table upload this cache exists to amortize —
+            # count it so /metrics shows the epoch-boundary spike
+            TRANSFER.inc("h2d", self._np.nbytes)
         return self._dev
 
     def pad_bits(self, bits):
@@ -378,14 +423,30 @@ def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
             from .ops import bls as OB
 
             asarray = jnp.asarray
-        fn = _get_agg_verify_fn() if _fused() else OB.agg_verify
+        fused = _fused()
+        fn = _get_agg_verify_fn() if fused else OB.agg_verify
+        bm = table.pad_bits(bits)
+        hh = np.asarray(I.g2_affine_to_arr(h))
+        sg = np.asarray(I.g2_affine_to_arr(sig_point))
+        TRANSFER.inc("h2d", bm.nbytes + hh.nbytes + sg.nbytes)
+        program = f"agg_verify_b{table.size}"
+        first = _program_first_use(program) if fused else False
+        t0 = time.monotonic()
         ok = fn(
-            table.device_array(),
-            asarray(table.pad_bits(bits)),
-            asarray(I.g2_affine_to_arr(h)),
-            asarray(I.g2_affine_to_arr(sig_point)),
+            table.device_array(), asarray(bm), asarray(hh), asarray(sg)
         )
-        return bool(np.asarray(ok))
+        res = np.asarray(ok)
+        if first:
+            JIT_COMPILE_SECONDS.set(time.monotonic() - t0,
+                                    program=program)
+        TRANSFER.inc("d2h", res.nbytes)
+        trace.annotate(
+            program=program, bucket=table.size,
+            jit_cache=("miss" if first else "hit") if fused else "eager",
+            h2d_bytes=bm.nbytes + hh.nbytes + sg.nbytes,
+            d2h_bytes=res.nbytes,
+        )
+        return bool(res)
 
     return _guarded("agg_verify", dispatch,
                     lambda: _ref_agg_verify(table, bits, h, sig_point))
@@ -435,7 +496,8 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
             asarray = jnp.asarray
         results = []
         widest = batch_buckets()[-1]
-        fn = (_get_agg_verify_batch_fn() if _fused()
+        fused = _fused()
+        fn = (_get_agg_verify_batch_fn() if fused
               else OB.agg_verify_batch)
         tbl = table.device_array()
         # dispatch EVERY chunk before syncing ANY result: a per-chunk
@@ -443,6 +505,8 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
         # programs, serializing the replay pipeline exactly where the
         # batched verification should stream (GL07)
         pending = []  # (ok device array, live lane count)
+        h2d = 0
+        compiles = []  # (program, first-dispatch seconds)
         for start in range(0, len(bits_list), widest):
             chunk_bits = bits_list[start:start + widest]
             chunk_h = h_points[start:start + widest]
@@ -452,12 +516,29 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
             bm = np.stack([table.pad_bits(chunk_bits[i]) for i in sel])
             hh = np.asarray(I.g2_batch_affine([chunk_h[i] for i in sel]))
             sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
+            h2d += bm.nbytes + hh.nbytes + sg.nbytes
+            program = f"agg_verify_batch_b{table.size}x{padded}"
+            first = _program_first_use(program) if fused else False
+            t0 = time.monotonic()
             ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
+            if first:
+                compiles.append((program, time.monotonic() - t0))
             COUNTERS.inc("batch_verify")
             pending.append((ok, n))
+        TRANSFER.inc("h2d", h2d)
+        d2h = 0
         for ok, n in pending:
             # all programs are in flight; this loop only drains results
-            results.extend(bool(x) for x in np.asarray(ok)[:n])
+            flat = np.asarray(ok)  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
+            d2h += flat.nbytes
+            results.extend(bool(x) for x in flat[:n])
+        TRANSFER.inc("d2h", d2h)
+        for program, dur in compiles:
+            JIT_COMPILE_SECONDS.set(dur, program=program)
+        trace.annotate(
+            chunks=len(pending), checks=len(bits_list),
+            jit_compiles=len(compiles), h2d_bytes=h2d, d2h_bytes=d2h,
+        )
         return results
 
     def fallback():
@@ -501,14 +582,30 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
         # every single check; eager (CPU): width 1, no padding — each
         # lane would re-run the whole pairing op-by-op.  Twin kernels
         # skip the padding: each lane costs a real host check
+        fused = _fused()
         width = (_VERIFY_BUCKET
-                 if _fused() and not kernel_twin_active() else 1)
+                 if fused and not kernel_twin_active() else 1)
         pk = np.asarray(I.g1_batch_affine([pk_point] * width))
         hh = np.asarray(I.g2_batch_affine([h] * width))
         sg = np.asarray(I.g2_batch_affine([sig_point] * width))
-        fn = _get_verify_fn() if _fused() else OB.verify
+        TRANSFER.inc("h2d", pk.nbytes + hh.nbytes + sg.nbytes)
+        program = f"verify_w{width}"
+        first = _program_first_use(program) if fused else False
+        t0 = time.monotonic()
+        fn = _get_verify_fn() if fused else OB.verify
         ok = fn(asarray(pk), asarray(hh), asarray(sg))
-        return bool(np.asarray(ok)[0])
+        res = np.asarray(ok)
+        if first:
+            JIT_COMPILE_SECONDS.set(time.monotonic() - t0,
+                                    program=program)
+        TRANSFER.inc("d2h", res.nbytes)
+        trace.annotate(
+            program=program, width=width,
+            jit_cache=("miss" if first else "hit") if fused else "eager",
+            h2d_bytes=pk.nbytes + hh.nbytes + sg.nbytes,
+            d2h_bytes=res.nbytes,
+        )
+        return bool(res[0])
 
     def fallback() -> bool:
         from .ref import bls as RB

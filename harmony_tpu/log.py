@@ -23,6 +23,8 @@ import sys
 import threading
 import time
 
+from . import trace as _trace
+
 DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
 _LEVEL_NAMES = {DEBUG: "debug", INFO: "info", WARN: "warn", ERROR: "error"}
 _NAME_LEVELS = {v: k for k, v in _LEVEL_NAMES.items()}
@@ -100,10 +102,17 @@ class Logger:
             "module": self.module,
             "msg": msg,
         }
+        # correlate with the active trace span (one comparison when
+        # tracing is disabled) — the flight recorder joins spans and
+        # log lines on these ids
+        ids = _trace.current_ids()
+        if ids is not None:
+            record["trace_id"], record["span_id"] = ids
         if self.ctx:
             record.update(self.ctx)
         if fields:
             record.update(fields)
+        _trace.record_log(record)
         _SINK.emit(record)
 
     def debug(self, msg: str, **fields):

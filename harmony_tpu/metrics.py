@@ -10,6 +10,7 @@ consume; the node wires one Registry through its subsystems.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -74,7 +75,11 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        # locked like inc/expose: the bare dict read raced concurrent
+        # first-inc inserts (dict resize mid-read) on the consensus
+        # threads; Gauge inherits this read too
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
@@ -192,6 +197,30 @@ class Registry:
             f"harmony_device_kernel_twin "
             f"{1 if DV.kernel_twin_active() else 0}"
         )
+        # the observability tier (ISSUE 4): dispatch latency histogram,
+        # host<->device transfer bytes, jit program-cache hits/misses
+        # and last-compile gauges — all module singletons in device.py
+        out.append(DV.DISPATCH_SECONDS.expose())
+        out.append(
+            "# HELP harmony_device_transfer_bytes_total host<->device "
+            "bytes shipped by dispatches\n"
+            "# TYPE harmony_device_transfer_bytes_total counter"
+        )
+        for direction, v in DV.TRANSFER.items():
+            out.append(
+                "harmony_device_transfer_bytes_total"
+                f'{{direction="{direction}"}} {v}'
+            )
+        out.append(
+            "# HELP harmony_device_jit_programs_total dispatches that "
+            "hit (reused) vs missed (compiled) a program shape\n"
+            "# TYPE harmony_device_jit_programs_total counter"
+        )
+        for kind, v in DV.JIT.items():
+            out.append(
+                f'harmony_device_jit_programs_total{{cache="{kind}"}} {v}'
+            )
+        out.append(DV.JIT_COMPILE_SECONDS.expose())
         return "\n".join(out)
 
     @staticmethod
@@ -215,79 +244,53 @@ class Registry:
         return "\n".join(out)
 
 
-def _pprof_stacks() -> str:
-    """All-thread stack dump — the role of pprof's goroutine profile
-    (reference: internal/profiler + net/http/pprof wiring in the node;
-    debug=1 text format)."""
-    import sys
-    import traceback
-
-    frames = sys._current_frames()
-    threads = {t.ident: t for t in threading.enumerate()}
-    out = []
-    for ident, frame in frames.items():
-        t = threads.get(ident)
-        name = t.name if t else f"thread-{ident}"
-        out.append(f"goroutine-analog: {name} (ident {ident})")
-        out.extend(
-            line.rstrip()
-            for line in traceback.format_stack(frame)
-        )
-        out.append("")
-    return "\n".join(out)
-
-
-class _Profiler:
-    """CPU profile start/stop (the role of pprof's /debug/pprof/profile,
-    cProfile-based; one profile at a time)."""
-
-    def __init__(self):
-        self._prof = None
-        self._lock = threading.Lock()
-
-    def toggle(self) -> str:
-        import cProfile
-        import io
-        import pstats
-
-        with self._lock:
-            if self._prof is None:
-                self._prof = cProfile.Profile()
-                self._prof.enable()
-                return "profiling started; GET again to stop\n"
-            prof, self._prof = self._prof, None
-            prof.disable()
-            buf = io.StringIO()
-            pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
-            return buf.getvalue()
-
-
 class MetricsServer:
-    """Serves a Registry at GET /metrics plus pprof-style debug
-    endpoints: /debug/pprof/stacks (all-thread dump) and
-    /debug/pprof/profile (toggle a cProfile run)."""
+    """The node's always-on debug listener: GET /metrics (Prometheus
+    text), /debug/pprof/* (mounted from pprof.py — the richer profiles;
+    this server used to carry its own weaker stack-dump/profiler
+    copies), and /debug/trace (Chrome trace-event JSON from the span
+    tracer's bounded store — load it in Perfetto)."""
 
     def __init__(self, registry: Registry, port: int = 0):
         outer_registry = registry
-        profiler = _Profiler()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
             def do_GET(self):
-                if self.path == "/metrics":
-                    data = outer_registry.expose().encode()
-                    ctype = "text/plain; version=0.0.4"
-                elif self.path == "/debug/pprof/stacks":
-                    data = _pprof_stacks().encode()
-                    ctype = "text/plain"
-                elif self.path == "/debug/pprof/profile":
-                    data = profiler.toggle().encode()
-                    ctype = "text/plain"
-                else:
-                    self.send_response(404)
-                    self.end_headers()
+                path, _, query = self.path.partition("?")
+                params = dict(
+                    kv.split("=", 1)
+                    for kv in query.split("&") if "=" in kv
+                )
+                try:
+                    if path == "/metrics":
+                        data = outer_registry.expose().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif path == "/debug/trace":
+                        from . import trace as TR
+
+                        data = json.dumps(
+                            TR.export_chrome(params.get("trace_id"))
+                        ).encode()
+                        ctype = "application/json"
+                    elif path.startswith("/debug/pprof"):
+                        from . import pprof as PP
+
+                        body = PP.handle(path, params)
+                        if body is None:
+                            self.send_response(404)
+                            self.end_headers()
+                            return
+                        data = body.encode()
+                        ctype = "text/plain; charset=utf-8"
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                except Exception as e:  # noqa: BLE001 — debug surface
+                    self.send_error(500, str(e))
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

@@ -23,6 +23,7 @@ import queue
 import threading
 import time
 
+from .. import trace
 from ..consensus.fbft import Leader, RoundConfig, Validator
 from ..consensus.messages import (
     FBFTMessage,
@@ -105,6 +106,16 @@ class Node:
         self._last_propose = 0.0
 
         self.log = get_logger("consensus", shard=self.chain.shard_id)
+        # per-round latency lands in the metrics registry when one is
+        # wired (cli.py does) — the BENCH-facing aggregate of the same
+        # timeline the round trace spans break down
+        mreg = registry.get("metrics")
+        self._round_seconds = (
+            mreg.histogram(
+                "harmony_consensus_round_seconds",
+                "announce-to-commit wall time of one FBFT round",
+            ) if mreg is not None else None
+        )
         self.host.add_validator(self.topic, self._gossip_validator)
         self.host.subscribe(self.topic, self._on_gossip)
         # live cross-shard receipt routing (reference:
@@ -178,6 +189,16 @@ class Node:
     # -- round lifecycle ----------------------------------------------------
 
     def _new_round(self):
+        # close any trace spans left from the previous round (a round
+        # that COMMITTED already finished them; this is the abandoned
+        # path — view change or sync rejoin)
+        rs = getattr(self, "_round_span", None)
+        if rs is not None:
+            rs.annotate(abandoned=True)
+            trace.finish(rs)
+        trace.finish(getattr(self, "_phase_span", None))
+        self._round_span = None
+        self._phase_span = None
         head = self.chain.current_header()
         self.block_num = head.block_num + 1
         # every node derives the same view id from the committed head
@@ -244,6 +265,11 @@ class Node:
         self._queue.put(payload)
 
     def _broadcast(self, msg: FBFTMessage, retry: bool = False):
+        # stamp the active trace context (unsigned trailer) so the
+        # receiving node's handler — and the device/sidecar work it
+        # triggers — lands under this round's trace
+        if not msg.trace_ctx:
+            msg.trace_ctx = trace.traceparent()
         env = pack_envelope(
             MessageCategory.CONSENSUS, int(msg.msg_type), encode_message(msg)
         )
@@ -257,9 +283,20 @@ class Node:
 
     def start_round_if_leader(self):
         """Leader proposes + announces (reference: consensus/proposer.go
-        WaitForConsensusReadyV2 -> ProposeNewBlock -> announce)."""
+        WaitForConsensusReadyV2 -> ProposeNewBlock -> announce).  Roots
+        the round's trace: every consensus message this round carries
+        its context, so one round = one trace across all components."""
         if not self.is_leader or self._proposed:
             return None
+        if self._round_span is None:
+            self._round_span = trace.start(
+                "consensus.round", component="consensus",
+                block=self.block_num, view=self.view_id, role="leader",
+            )
+        with trace.use(self._round_span):
+            return self._propose_and_announce()
+
+    def _propose_and_announce(self):
         if self._reproposal is not None:
             # re-announce the view-change-carried block UNCHANGED (same
             # hash — PBFT safety); commit payloads bind its original view
@@ -288,13 +325,23 @@ class Node:
         self._pending_block = block
         self._proposed = True
         self._last_propose = time.monotonic()
-        msg = self.leader.announce(block.hash(), block_bytes)
-        self.log.info(
-            "announce", block=block.block_num, view=self.view_id,
-            hash=block.hash().hex()[:16],
-            txs=len(block.transactions) + len(block.staking_transactions),
+        with trace.span("consensus.phase.announce", component="consensus",
+                        block=block.block_num, view=self.view_id):
+            msg = self.leader.announce(block.hash(), block_bytes)
+            self.log.info(
+                "announce", block=block.block_num, view=self.view_id,
+                hash=block.hash().hex()[:16],
+                txs=len(block.transactions)
+                + len(block.staking_transactions),
+            )
+            self._broadcast(msg, retry=True)
+        # the prepare-quorum phase runs from announce until PREPARED —
+        # its span is owned here (finished in _leader_advance) because
+        # it spans many pump iterations
+        self._phase_span = trace.start(
+            "consensus.phase.prepare_quorum", component="consensus",
+            parent=self._round_span, block=block.block_num,
         )
-        self._broadcast(msg, retry=True)
         # a leader whose own keys already meet quorum (single-operator
         # committee) must advance without waiting for external votes
         self._leader_advance()
@@ -376,12 +423,33 @@ class Node:
                     self._spin_up_sync()
             return
         self._ahead_runs = 0
+        try:
+            # continue the trace carried by the message: the sender-sig
+            # check, the handler and every device dispatch / sidecar
+            # call / finalize they reach nest under the originating
+            # round's trace
+            with trace.resume(
+                msg.trace_ctx,
+                f"consensus.{msg.msg_type.name.lower()}",
+                component="consensus", block=msg.block_num,
+                view=msg.view_id,
+            ):
+                self._handle_verified(msg)
+        except Exception as e:
+            # tolerant message loop (the reference logs and moves on):
+            # one malformed message must never kill the consensus pump
+            self.dropped_messages += 1
+            self.log.warn("consensus message dropped",
+                          msg_type=int(msg.msg_type), error=str(e))
+
+    def _handle_verified(self, msg: FBFTMessage):
         # the sender must have SIGNED this exact message — without this
         # gate any peer could replay/forge another member's ANNOUNCE /
         # PREPARED / COMMITTED (reference verifies the message signature
         # on every consensus message, consensus/checks.go)
         if not verify_sender_sig(msg):
             self.dropped_messages += 1
+            trace.annotate(dropped="bad_sender_sig")
             return
         handler = {
             MsgType.ANNOUNCE: self._on_announce,
@@ -392,16 +460,8 @@ class Node:
             MsgType.VIEWCHANGE: self._on_viewchange_msg,
             MsgType.NEWVIEW: self._on_newview_msg,
         }.get(msg.msg_type)
-        if handler is None:
-            return
-        try:
+        if handler is not None:
             handler(msg)
-        except Exception as e:
-            # tolerant message loop (the reference logs and moves on):
-            # one malformed message must never kill the consensus pump
-            self.dropped_messages += 1
-            self.log.warn("consensus message dropped",
-                          msg_type=int(msg.msg_type), error=str(e))
 
     # -- FBFT phase handlers ------------------------------------------------
 
@@ -540,6 +600,14 @@ class Node:
                     "prepared quorum", block=self.block_num,
                     view=self.view_id,
                 )
+                # prepare-quorum reached: close its phase span, open
+                # the commit-quorum one (both parented to the round)
+                trace.finish(self._phase_span)
+                self._phase_span = trace.start(
+                    "consensus.phase.commit_quorum",
+                    component="consensus", parent=self._round_span,
+                    block=self.block_num,
+                )
                 self._broadcast(prepared, retry=True)
                 # leader self-commits with its own keys
                 # (reference: threshold.go:53-69)
@@ -550,6 +618,8 @@ class Node:
             committed = self.leader.try_committed(block_hash)
             if committed is not None:
                 self._sent_committed = True
+                trace.finish(self._phase_span)
+                self._phase_span = None
                 self._broadcast(committed, retry=True)
                 self._commit_block(committed)
 
@@ -662,20 +732,42 @@ class Node:
         block = self._pending_block
         if block is None or block.hash() != msg.block_hash:
             return
-        try:
-            self.chain.insert_chain(
-                [block], commit_sigs=[msg.payload],
-                verify_seals=self.chain.engine is not None,
-            )
-        except ChainError as e:
-            self.log.error(
-                "commit insert failed", block=block.block_num, err=str(e)
-            )
-            return
+        with trace.span("chain.finalize", component="chain",
+                        block=block.block_num):
+            try:
+                self.chain.insert_chain(
+                    [block], commit_sigs=[msg.payload],
+                    verify_seals=self.chain.engine is not None,
+                )
+            except ChainError as e:
+                trace.annotate(error=str(e))
+                self.log.error(
+                    "commit insert failed", block=block.block_num,
+                    err=str(e),
+                )
+                return
         self.log.info(
             "committed", block=block.block_num, view=self.view_id,
             hash=block.hash().hex()[:16],
         )
+        # the round's timeline closes here: latency to the histogram,
+        # the root span to the store, and — when an SLO is armed and
+        # overrun — one flight-recorder dump of the slow round
+        round_s = time.monotonic() - self._round_start
+        if self._round_seconds is not None:
+            self._round_seconds.observe(round_s)
+        rs = self._round_span
+        if rs is not None:
+            self._round_span = None
+            rs.annotate(round_s=round(round_s, 6))
+            trace.finish(rs)
+            slo = trace.round_slo_s()
+            if slo is not None and round_s > slo:
+                trace.anomaly(
+                    "round_slo", trace_id=rs.trace_id,
+                    block=block.block_num, round_s=round(round_s, 3),
+                    slo_s=slo,
+                )
         if self.pool is not None:
             self.pool.drop_applied()
         self.sender.stop_retry(block.block_num)
@@ -761,6 +853,14 @@ class Node:
         self.log.warn(
             "view change start", block=self.block_num, new_view=new_view,
             had_prepared=self._prepared_proof is not None,
+        )
+        # a view change IS the anomaly the flight recorder exists for:
+        # dump the wedged round's spans + correlated log lines
+        trace.anomaly(
+            "view_change",
+            trace_id=(self._round_span.trace_id
+                      if self._round_span is not None else None),
+            block=self.block_num, new_view=new_view,
         )
         prepared_hash = None
         if self._prepared_proof is not None and self._pending_block is not None:

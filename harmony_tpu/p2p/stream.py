@@ -9,7 +9,13 @@ requests carry ids so responses match out-of-order; the server side
 answers from a Blockchain.
 
 Wire: [u32 len][u8 kind][u64 req_id][payload]; kinds are REQ/RESP with
-a method byte leading the payload.
+a method byte leading the payload.  Bit 6 of kind marks an optional
+trace context: the payload is then prefixed [u8 tc_len][traceparent]
+(harmony_tpu.trace binary form) — requests only, responses stay plain.
+Untraced clients speak the original wire format unchanged; a traced
+client needs a flag-aware server (a pre-flag server drops flagged
+requests), so arm tracing fleet-wide, not per node, when mixing
+versions.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ import struct
 import threading
 
 from .. import faultinject as FI
+from .. import trace
 from ..core import rawdb
 from ..core.types import _enc_bytes, _enc_int
 from ..core.types import Reader as _Reader
@@ -27,6 +34,7 @@ from ..core.types import Reader as _Reader
 PROTOCOL_VERSION = 1
 _HDR = struct.Struct("<IBQ")
 _REQ, _RESP = 1, 2
+_TRACE_FLAG = 0x40
 
 METHOD_BLOCK_HASHES = 1    # [u64 start][u32 count] -> [hash...]
 METHOD_BLOCKS_BY_NUM = 2   # [u64 start][u32 count] -> [block blob...]
@@ -92,12 +100,19 @@ class SyncServer:
                     return
                 ln, kind, req_id = _HDR.unpack(hdr)
                 body = _recv_exact(sock, ln)
-                if body is None or kind != _REQ:
+                if body is None or (kind & ~_TRACE_FLAG) != _REQ:
                     return
+                tc = b""
+                if kind & _TRACE_FLAG:
+                    if not body or len(body) < 1 + body[0]:
+                        return  # truncated trace prefix: drop the conn
+                    tc, body = body[1:1 + body[0]], body[1 + body[0]:]
                 # back-pressure, not drop: every request consumes a
                 # token, waiting for one when the bucket is dry
                 self.limiter.wait(conn_key)
-                resp = self._handle(body)
+                with trace.resume(tc, "p2p.serve", component="p2p",
+                                  method=body[0] if body else -1):
+                    resp = self._handle(body)
                 sock.sendall(_HDR.pack(len(resp), _RESP, req_id) + resp)
         except OSError:
             pass
@@ -344,39 +359,45 @@ class SyncClient:
         the downloader propagates one budget across a whole stage so a
         black-holed peer costs bounded time, not 30 s per request."""
         FI.fire("p2p.stream", key=self.peer_key)
-        sock = self._ensure_connected(deadline)
-        # the wait budget is re-taken AFTER the dial so a slow connect
-        # and the response wait share ONE deadline, not two
-        timeout = (self._timeout if deadline is None
-                   else deadline.bound(self._timeout))
-        if timeout is not None and timeout <= 0:
-            raise ConnectionError("sync request deadline exhausted")
-        with self._lock:
-            self._next_id += 1
-            req_id = self._next_id
-            slot = _PendingReply()
-            self._pending[req_id] = slot
-        try:
-            try:
-                # _send_lock only keeps concurrent frames from
-                # interleaving; the response wait below happens with NO
-                # lock held, so calls overlap on the wire
-                with self._send_lock:
-                    sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
-                        _HDR.pack(len(payload), _REQ, req_id) + payload
-                    )
-            except OSError:
-                self._drop(sock)
-                raise
-            if not slot.event.wait(timeout):
-                self._drop(sock)  # wedged peer: fail everyone, redial
-                raise ConnectionError("sync request timed out")
-            if slot.body is None:
-                raise ConnectionError("sync stream closed")
-            return slot.body
-        finally:
+        with trace.span("p2p.request", component="p2p",
+                        peer=self.peer_key,
+                        method=payload[0] if payload else -1):
+            sock = self._ensure_connected(deadline)
+            # the wait budget is re-taken AFTER the dial so a slow
+            # connect and the response wait share ONE deadline, not two
+            timeout = (self._timeout if deadline is None
+                       else deadline.bound(self._timeout))
+            if timeout is not None and timeout <= 0:
+                raise ConnectionError("sync request deadline exhausted")
+            tc = trace.traceparent()
+            kind = _REQ | _TRACE_FLAG if tc else _REQ
+            wire = (bytes([len(tc)]) + tc + payload) if tc else payload
             with self._lock:
-                self._pending.pop(req_id, None)
+                self._next_id += 1
+                req_id = self._next_id
+                slot = _PendingReply()
+                self._pending[req_id] = slot
+            try:
+                try:
+                    # _send_lock only keeps concurrent frames from
+                    # interleaving; the response wait below happens with
+                    # NO lock held, so calls overlap on the wire
+                    with self._send_lock:
+                        sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
+                            _HDR.pack(len(wire), kind, req_id) + wire
+                        )
+                except OSError:
+                    self._drop(sock)
+                    raise
+                if not slot.event.wait(timeout):
+                    self._drop(sock)  # wedged peer: fail all, redial
+                    raise ConnectionError("sync request timed out")
+                if slot.body is None:
+                    raise ConnectionError("sync stream closed")
+                return slot.body
+            finally:
+                with self._lock:
+                    self._pending.pop(req_id, None)
 
     def get_head(self, deadline=None) -> tuple[int, bytes]:
         resp = self._call(bytes([METHOD_HEAD]), deadline)

@@ -111,6 +111,28 @@ def threadz() -> str:
     return "\n".join(lines)
 
 
+def handle(path: str, params: dict) -> str | None:
+    """Route one /debug/pprof request to its profile; None = unknown
+    path.  The ONE routing table for every debug listener — both
+    PprofServer and metrics.MetricsServer mount this (the r3 metrics
+    server carried its own weaker copies of the stack dump and CPU
+    profiler; those are gone)."""
+    if path in ("/", "/debug/pprof", "/debug/pprof/"):
+        return _INDEX
+    if path in ("/debug/pprof/goroutine", "/debug/pprof/stacks"):
+        # /stacks kept as an operator-facing alias of the old metrics
+        # endpoint name
+        return thread_dump()
+    if path == "/debug/pprof/profile":
+        secs = min(float(params.get("seconds", 5)), 120.0)
+        return cpu_profile(secs)
+    if path == "/debug/pprof/heap":
+        return heap_profile()
+    if path == "/debug/pprof/threadz":
+        return threadz()
+    return None
+
+
 class PprofServer:
     """Serves the profiles over localhost HTTP (reference:
     api/service/pprof/service.go Start/Stop lifecycle)."""
@@ -128,18 +150,8 @@ class PprofServer:
                     kv.split("=", 1) for kv in query.split("&") if "=" in kv
                 )
                 try:
-                    if path in ("/", "/debug/pprof", "/debug/pprof/"):
-                        body = _INDEX
-                    elif path == "/debug/pprof/goroutine":
-                        body = thread_dump()
-                    elif path == "/debug/pprof/profile":
-                        secs = min(float(params.get("seconds", 5)), 120.0)
-                        body = cpu_profile(secs)
-                    elif path == "/debug/pprof/heap":
-                        body = heap_profile()
-                    elif path == "/debug/pprof/threadz":
-                        body = threadz()
-                    else:
+                    body = handle(path, params)
+                    if body is None:
                         self.send_error(404)
                         return
                 except Exception as e:  # noqa: BLE001 — debug surface

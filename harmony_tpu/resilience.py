@@ -29,6 +29,7 @@ import hashlib
 import threading
 import time
 
+from . import trace
 from .log import get_logger
 from .metrics import LockedCounters
 
@@ -198,6 +199,11 @@ class CircuitBreaker:
             TRANSITIONS.inc(f"{self.name}:{event}")
             if event == "open":
                 _log.warn("breaker opened", breaker=self.name)
+                # flight recorder: one correlated dump of the spans +
+                # log lines of the round that tripped the breaker
+                # (no-op while tracing is disarmed; runs OUTSIDE
+                # self._lock like everything in _note)
+                trace.anomaly("breaker_open", breaker=self.name)
             elif event in ("half_open", "close"):
                 _log.info(f"breaker {event}", breaker=self.name)
 

@@ -24,6 +24,7 @@ import socket
 import threading
 
 from .. import faultinject as FI
+from .. import trace
 from ..log import get_logger
 from ..resilience import Deadline, RetryPolicy
 from . import protocol as P
@@ -153,23 +154,33 @@ class SidecarClient:
     def _read_loop(self, sock) -> None:
         """Demultiplex response frames to their waiters by request id.
         Any protocol violation — truncated frame, garbage, a reply to
-        an id nobody is waiting on — is a stream desync: fail closed."""
+        an id nobody is waiting on — is a stream desync: fail closed
+        (and fire the flight recorder; a desynced verification stream
+        is exactly the snapshot an operator wants)."""
+        desync = None
         while True:
             try:
                 FI.fire("sidecar.frame")
                 frame = P.read_frame(sock)
-            except (ValueError, OSError):
-                break  # garbage or dead socket: never trust the stream
+            except ValueError as e:
+                desync = f"garbage frame: {e}"
+                break  # never trust the stream again
+            except OSError:
+                break  # dead socket
             if frame is None:
                 break  # clean EOF
             rtype, rid, rbody = frame
             with self._lock:
                 slot = self._pending.get(rid)
             if slot is None:
+                desync = f"reply to unknown request id {rid}"
                 break  # reply to nobody: mid-frame desync, fail closed
             slot.frame = (rtype, rbody)
             slot.event.set()
         self._drop(sock)
+        if desync is not None:
+            _log.warn("sidecar stream desync", error=desync)
+            trace.anomaly("sidecar_desync", error=desync)
 
     def _drop(self, sock) -> None:
         """Retire a socket and fail every waiter parked on it.  Only
@@ -218,7 +229,8 @@ class SidecarClient:
                 # lock held, so calls overlap on the wire
                 with self._send_lock:
                     sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
-                        P.pack_frame(msg_type, rid, body)
+                        P.pack_frame(msg_type, rid, body,
+                                     trace.traceparent())
                     )
             except OSError as e:
                 self._drop(sock)
@@ -251,14 +263,25 @@ class SidecarClient:
             sock = self._ensure_connected(dl)
             return self._request(sock, msg_type, body, dl)
 
-        try:
-            return self._retry.run(
-                attempt, retry_on=(OSError,), deadline=dl, key="sidecar"
-            )
-        except SidecarUnavailable:
-            raise
-        except OSError as e:  # dial failures, DeadlineExceeded
-            raise SidecarUnavailable(f"sidecar unreachable: {e}") from e
+        # the span covers dial + retries + replay: the time consensus
+        # actually waited on the sidecar, not one socket round-trip.
+        # _request reads traceparent() inside this context, so the
+        # server resumes the round's trace across reconnects too.
+        with trace.span("sidecar.call", component="sidecar",
+                        msg_type=msg_type):
+            try:
+                return self._retry.run(
+                    attempt, retry_on=(OSError,), deadline=dl,
+                    key="sidecar",
+                )
+            except SidecarUnavailable as e:
+                trace.annotate(error=str(e))
+                raise
+            except OSError as e:  # dial failures, DeadlineExceeded
+                trace.annotate(error=str(e))
+                raise SidecarUnavailable(
+                    f"sidecar unreachable: {e}"
+                ) from e
 
     # -- API -----------------------------------------------------------------
 

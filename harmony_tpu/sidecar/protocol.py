@@ -7,6 +7,17 @@ Frame layout (little-endian):
 frame_len counts everything after itself.  Responses echo request_id and
 set bit 7 of msg_type; body starts with a u8 status (0 = OK).
 
+Bit 6 of msg_type (TRACE_FLAG) marks an OPTIONAL trace context: the
+body is then prefixed with [u8 tc_len][tc_len bytes traceparent]
+(harmony_tpu.trace binary form).  Requests only; responses are always
+sent with the base type | RESP_FLAG.  Clients that never set the bit
+(the native C++ client) speak the v1 wire format unchanged.  The
+reverse skew — a TRACED client against a server that predates the
+bit — is NOT compatible: such a server would echo the flagged type in
+its response and the client's type check would treat that as a stream
+desync, so arm tracing only against a TRACE_FLAG-aware sidecar (both
+halves live in this repo and ship together).
+
 Message bodies:
 
     PING          -> empty; response body: protocol version u16
@@ -35,6 +46,7 @@ MSG_PING = 0x01
 MSG_SET_COMMITTEE = 0x02
 MSG_AGG_VERIFY = 0x03
 MSG_VERIFY_BATCH = 0x04
+TRACE_FLAG = 0x40
 RESP_FLAG = 0x80
 
 STATUS_OK = 0
@@ -43,11 +55,30 @@ STATUS_UNKNOWN_COMMITTEE = 2
 STATUS_BAD_REQUEST = 3
 
 
-def pack_frame(msg_type: int, request_id: int, body: bytes) -> bytes:
+def pack_frame(msg_type: int, request_id: int, body: bytes,
+               trace_ctx: bytes = b"") -> bytes:
+    if trace_ctx:
+        if len(trace_ctx) > 255:
+            raise ValueError("trace context too large")
+        msg_type |= TRACE_FLAG
+        body = bytes([len(trace_ctx)]) + trace_ctx + body
     frame_len = 1 + 4 + len(body)
     if frame_len > MAX_FRAME:
         raise ValueError("frame too large")
     return struct.pack("<IBI", frame_len, msg_type, request_id) + body
+
+
+def split_trace(msg_type: int, body: bytes):
+    """(base msg_type, trace_ctx, body) — strips the TRACE_FLAG prefix
+    when present.  A truncated prefix raises ValueError (frame-level
+    garbage, same contract as read_frame)."""
+    if not msg_type & TRACE_FLAG:
+        return msg_type, b"", body
+    if not body or len(body) < 1 + body[0]:
+        raise ValueError("truncated trace context")
+    tc_len = body[0]
+    return (msg_type & ~TRACE_FLAG, body[1:1 + tc_len],
+            body[1 + tc_len:])
 
 
 def unpack_frame(data: bytes):

@@ -17,6 +17,7 @@ import socket
 import struct
 import threading
 
+from .. import trace
 from ..ref import bls as RB
 from ..ref.hash_to_curve import hash_to_g2
 from . import protocol as P
@@ -105,7 +106,13 @@ class SidecarServer:
                 if frame is None:
                     return
                 msg_type, req_id, body = frame
-                status, resp = self._dispatch(msg_type, body)
+                # resume the caller's trace so the device work this
+                # request triggers lands under the round that sent it
+                msg_type, tc, body = P.split_trace(msg_type, body)
+                with trace.resume(tc, "sidecar.serve",
+                                  component="sidecar",
+                                  msg_type=msg_type):
+                    status, resp = self._dispatch(msg_type, body)
                 conn.sendall(
                     P.pack_frame(
                         msg_type | P.RESP_FLAG, req_id, bytes([status]) + resp

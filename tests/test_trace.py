@@ -1,0 +1,524 @@
+"""ISSUE 4 observability tier: the span tracer, cross-boundary trace
+propagation (consensus messages, sidecar frames, p2p streams, device
+dispatch), the flight recorder, and the /debug/trace export.
+
+Device kernels are the numpy/bigint twins (same trick as test_chaos:
+real verify decisions, no XLA pairing compiles on the CPU image) and
+``device.use_device(True)`` forces the device branches where a test
+needs them — every span asserted here comes from the REAL dispatch
+path, not a mock.
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu import device as DV
+from harmony_tpu import faultinject as FI
+from harmony_tpu import trace
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.log import get_logger, init_logging
+from harmony_tpu.ops import bls as OB
+from harmony_tpu.ref import bls as RB
+from harmony_tpu.ref.curve import g1
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    """Every test starts disarmed and dumps into its own tmp dir."""
+    trace.reset()
+    FI.reset()
+    trace.configure(dump_dir=str(tmp_path))
+    yield
+    trace.reset()
+    FI.reset()
+    DV.set_dispatch_deadline(None)
+
+
+# -- tracer core -------------------------------------------------------------
+
+
+def test_span_nesting_and_context():
+    trace.configure(enabled=True)
+    with trace.span("round", component="consensus") as root:
+        assert trace.current_span() is root
+        with trace.span("dispatch", component="device") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+        assert trace.current_span() is root
+    assert trace.current_span() is None
+    spans = trace.spans(root.trace_id)
+    assert {s.name for s in spans} == {"round", "dispatch"}
+    assert all(s.dur_s is not None for s in spans)
+
+
+def test_traceparent_roundtrip_and_garbage():
+    trace.configure(enabled=True)
+    with trace.span("r") as sp:
+        tc = trace.traceparent()
+        assert len(tc) == trace.TRACEPARENT_LEN
+        assert trace.parse_traceparent(tc) == (sp.trace_id, sp.span_id)
+    # malformed context never raises, never records
+    for junk in (b"", b"junk", b"\xff" * 26, b"\x00" * 25):
+        assert trace.parse_traceparent(junk) is None
+        with trace.resume(junk, "x"):
+            pass
+    assert not trace.spans(trace_id="ffffffffffffffffffffffffffffffff")
+
+
+def test_sampling_knob_deterministic():
+    trace.configure(enabled=True, sample_rate=0.0)
+    with trace.span("unsampled"):
+        assert trace.traceparent() == b""
+    assert trace.spans() == []
+    trace.configure(sample_rate=1.0)
+    with trace.span("sampled"):
+        pass
+    assert len(trace.spans()) == 1
+
+
+def test_disabled_cost_is_a_comparison():
+    """THE acceptance overhead bound: tracing disabled must add no
+    measurable per-dispatch cost.  The disabled entry points return one
+    shared no-op after a single flag check — asserted structurally
+    (identity) and by a generous micro-benchmark bound (<20 us/call
+    including the with-statement, ~50x the observed cost, so a loaded
+    CI box never flakes this)."""
+    assert not trace.enabled()
+    assert trace.span("a") is trace.span("b")  # shared no-op singleton
+    assert trace.traceparent() == b""
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("device.dispatch", component="device"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 20e-6, f"disabled span cost {per_call * 1e6:.2f}us"
+
+
+# -- consensus message codec -------------------------------------------------
+
+
+def test_fbft_message_carries_unsigned_trace_ctx():
+    from harmony_tpu.consensus.messages import (
+        FBFTMessage, MsgType, decode_message, encode_message,
+    )
+
+    m = FBFTMessage(MsgType.PREPARE, 1, 2, b"\x00" * 32,
+                    [b"\x01" * 48], b"sig-bytes")
+    legacy = encode_message(m)  # no trailer when no context
+    assert decode_message(legacy).trace_ctx == b""
+    m.trace_ctx = b"\x00" + b"\xab" * 16 + b"\xcd" * 8 + b"\x01"
+    wired = decode_message(encode_message(m))
+    assert wired.trace_ctx == m.trace_ctx
+    assert wired.payload == m.payload
+    # the context is transport metadata: same signable bytes, same key
+    from harmony_tpu.consensus.messages import signable_bytes
+
+    assert signable_bytes(wired) == signable_bytes(
+        decode_message(legacy)
+    )
+    assert wired.key() == decode_message(legacy).key()
+    # truncated trailer is malformed wire, not a crash
+    with pytest.raises(ValueError):
+        decode_message(encode_message(m)[:-3])
+
+
+# -- log correlation ---------------------------------------------------------
+
+
+def test_log_records_carry_trace_ids_and_feed_recorder():
+    import sys
+
+    trace.configure(enabled=True)
+    buf = io.StringIO()
+    init_logging(level="info", stream=buf)
+    try:
+        log = get_logger("test-trace")
+        with trace.span("round", component="consensus") as sp:
+            log.info("inside the round", block=7)
+        log.info("outside any span")
+    finally:
+        init_logging(stream=sys.stderr)
+    lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    inside = next(ln for ln in lines if ln["msg"] == "inside the round")
+    outside = next(ln for ln in lines if ln["msg"] == "outside any span")
+    assert inside["trace_id"] == sp.trace_id
+    assert inside["span_id"] == sp.span_id
+    assert "trace_id" not in outside
+    # the same record reached the flight recorder's event ring
+    dump = trace.anomaly("unit_test", trace_id=sp.trace_id)
+    payload = json.load(open(dump))
+    assert any(r["msg"] == "inside the round" for r in payload["logs"])
+    assert all(r.get("trace_id") == sp.trace_id for r in payload["logs"])
+
+
+# -- device dispatch spans + metrics ----------------------------------------
+
+
+N_KEYS = 4
+
+
+def _twin_agg_verify(pk_affs, bitmap, h_aff, agg_sig_aff):
+    from harmony_tpu.ops import interop as I
+
+    tbl = np.asarray(pk_affs)
+    agg = None
+    for i, bit in enumerate(np.asarray(bitmap)):
+        if bit:
+            agg = g1.add(agg, (I.arr_to_fp(tbl[i][0]),
+                               I.arr_to_fp(tbl[i][1])))
+    if agg is None:
+        return np.asarray(False)
+    h = (I.arr_to_fp2(np.asarray(h_aff)[0]),
+         I.arr_to_fp2(np.asarray(h_aff)[1]))
+    s = (I.arr_to_fp2(np.asarray(agg_sig_aff)[0]),
+         I.arr_to_fp2(np.asarray(agg_sig_aff)[1]))
+    return np.asarray(RB.verify_hashed(agg, h, s))
+
+
+def _twin_verify(pk_affs, h_affs, sig_affs):
+    from harmony_tpu.ops import interop as I
+
+    out = []
+    for pk, h, s in zip(np.asarray(pk_affs), np.asarray(h_affs),
+                        np.asarray(sig_affs)):
+        out.append(RB.verify_hashed(
+            (I.arr_to_fp(pk[0]), I.arr_to_fp(pk[1])),
+            (I.arr_to_fp2(h[0]), I.arr_to_fp2(h[1])),
+            (I.arr_to_fp2(s[0]), I.arr_to_fp2(s[1])),
+        ))
+    return np.asarray(out)
+
+
+@pytest.fixture
+def forced_device(monkeypatch):
+    """Force the device path with cheap numpy/bigint twins standing in
+    for the XLA kernels (the test_chaos recipe) and isolate the
+    program-shape cache so hit/miss accounting starts fresh."""
+    DV.use_device(True)
+    monkeypatch.setattr(OB, "agg_verify", _twin_agg_verify)
+    monkeypatch.setattr(OB, "verify", _twin_verify)
+    monkeypatch.setattr(DV, "_SEEN_PROGRAMS", set())
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    monkeypatch.setattr(
+        "harmony_tpu.ops.twin.agg_verify", _twin_agg_verify
+    )
+    monkeypatch.setattr("harmony_tpu.ops.twin.verify", _twin_verify)
+    yield
+    DV.use_device(None)
+
+
+@pytest.fixture
+def committee():
+    keys = [B.PrivateKey.generate(bytes([60 + i])) for i in range(N_KEYS)]
+    return keys, [k.pub.bytes for k in keys]
+
+
+def test_device_dispatch_spans_and_new_metrics(forced_device, committee):
+    from harmony_tpu.metrics import Registry
+
+    keys, serialized = committee
+    trace.configure(enabled=True)
+    payload = b"observability-payload-32-bytes!!"
+    sigs = [keys[i].sign_hash(payload) for i in range(3)]
+    agg = B.aggregate_sigs(sigs)
+    table = DV.get_committee_table(
+        serialized, [k.pub.point for k in keys]
+    )
+    h2d0, d2h0 = DV.TRANSFER["h2d"], DV.TRANSFER["d2h"]
+    hit0, miss0 = DV.JIT["hit"], DV.JIT["miss"]
+    with trace.span("round", component="consensus") as root:
+        for _ in range(3):
+            assert DV.agg_verify_on_device(
+                table, [1, 1, 1, 0], payload, agg.point
+            )
+    spans = [s for s in trace.spans(root.trace_id)
+             if s.name == "device.dispatch"]
+    assert len(spans) == 3
+    assert all(s.parent_id == root.span_id for s in spans)
+    # annotated with the program shape + jit-cache verdict
+    caches = sorted(s.attrs["jit_cache"] for s in spans)
+    assert caches == ["hit", "hit", "miss"]
+    assert all(s.attrs["h2d_bytes"] > 0 for s in spans)
+    # metrics: transfer bytes moved, exactly one compile, two reuses
+    assert DV.TRANSFER["h2d"] > h2d0 and DV.TRANSFER["d2h"] > d2h0
+    assert DV.JIT["miss"] == miss0 + 1 and DV.JIT["hit"] == hit0 + 2
+    text = Registry().expose()
+    assert "harmony_device_dispatch_seconds_count" in text
+    assert 'harmony_device_transfer_bytes_total{direction="h2d"}' in text
+    assert 'harmony_device_jit_programs_total{cache="miss"}' in text
+    assert "harmony_device_jit_compile_seconds" in text
+
+
+# -- sidecar propagation + reconnect ----------------------------------------
+
+
+def test_sidecar_reconnect_resumes_trace(committee):
+    """Satellite: kill the sidecar stream mid-round (faultinject) —
+    the replayed connection resumes spans under the SAME trace_id,
+    with no orphan spans, and the desync fires the flight recorder."""
+    from harmony_tpu.sidecar import protocol as P
+    from harmony_tpu.sidecar.client import SidecarClient
+    from harmony_tpu.sidecar.server import SidecarServer
+
+    keys, serialized = committee
+    trace.configure(enabled=True)
+    srv = SidecarServer().start()
+    c = SidecarClient(srv.address)
+    try:
+        c.set_committee(3, 0, serialized)
+        # the reader is parked in read_frame, so the armed fault fires
+        # on its NEXT wakeup — right after the first in-round reply:
+        # stream desync -> fail closed -> the next call redials,
+        # REPLAYS the committee, and retries, all under the same trace
+        FI.arm("sidecar.frame", exc=ValueError("injected garble"),
+               times=1)
+        payload = b"mid-round sidecar check payload!"
+        mask = Mask([k.pub.point for k in keys])
+        for i in range(3):
+            mask.set_bit(i, True)
+        agg = B.aggregate_sigs(
+            [keys[i].sign_hash(payload) for i in range(3)]
+        )
+        with trace.span("round", component="consensus") as root:
+            c.agg_verify(3, 0, payload, mask.mask_bytes(), agg.bytes)
+            # the armed fault now kills the stream (desync, fail
+            # closed) — wait for the drop, mid-round
+            deadline = time.monotonic() + 5.0
+            while c._sock is not None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert c._sock is None, "injected desync did not drop conn"
+            # second in-round call: redial + committee replay + retry
+            c.agg_verify(3, 0, payload, mask.mask_bytes(), agg.bytes)
+    finally:
+        c.close()
+        srv.stop()
+    spans = trace.spans(root.trace_id)
+    ids = {s.span_id for s in spans}
+    # no orphans: every parent is in this trace (or the root itself)
+    assert all(s.parent_id in ids for s in spans if s.parent_id)
+    comps = {s.name for s in spans}
+    assert "sidecar.call" in comps and "sidecar.serve" in comps
+    # the replayed connection resumed under the round's trace: the
+    # server saw BOTH the replayed SET_COMMITTEE and the retried
+    # AGG_VERIFY inside trace root
+    serve_types = sorted(
+        s.attrs["msg_type"] for s in spans if s.name == "sidecar.serve"
+    )
+    assert P.MSG_SET_COMMITTEE in serve_types
+    assert P.MSG_AGG_VERIFY in serve_types
+    assert FI.hits("sidecar.frame") > 0
+    # the desync anomaly produced a flight-recorder dump
+    kinds = [json.load(open(p))["kind"] for p in trace.dumps()]
+    assert kinds.count("sidecar_desync") == 1
+
+
+# -- p2p stream propagation --------------------------------------------------
+
+
+def test_p2p_stream_propagates_trace():
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+
+    genesis, _, _ = dev_genesis()
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    srv = SyncServer(chain)
+    trace.configure(enabled=True)
+    try:
+        cli = SyncClient(srv.port)
+        with trace.span("round", component="consensus") as root:
+            head, _ = cli.get_head()
+            assert head == 0
+        # untraced calls stay wire-compatible (no flag, no prefix)
+        trace.configure(enabled=False)
+        head, _ = cli.get_head()
+        assert head == 0
+        cli.close()
+    finally:
+        srv.close()
+    trace.configure(enabled=True)
+    spans = trace.spans(root.trace_id)
+    names = {s.name for s in spans}
+    assert "p2p.request" in names and "p2p.serve" in names
+    req = next(s for s in spans if s.name == "p2p.request")
+    serve = next(s for s in spans if s.name == "p2p.serve")
+    assert serve.parent_id == req.span_id
+
+
+# -- flight recorder: breaker open ------------------------------------------
+
+
+def test_breaker_open_dumps_exactly_once(forced_device, committee,
+                                         monkeypatch):
+    """A breaker-open event triggers EXACTLY ONE flight-recorder dump,
+    containing the offending round's spans and its correlated log
+    lines; further rejected dispatches do not re-dump."""
+    from harmony_tpu.resilience import CircuitBreaker
+
+    keys, serialized = committee
+    trace.configure(enabled=True)
+    brk = CircuitBreaker("trace-test-device", failure_threshold=1,
+                         reset_timeout_s=60.0)
+    monkeypatch.setattr(DV, "BREAKER", brk)
+    FI.arm("device.dispatch", exc=RuntimeError("injected wedge"))
+    payload = b"breaker-open round payload bytes"
+    sigs = [keys[i].sign_hash(payload) for i in range(3)]
+    agg = B.aggregate_sigs(sigs)
+    table = DV.get_committee_table(
+        serialized, [k.pub.point for k in keys]
+    )
+    with trace.span("consensus.round", component="consensus",
+                    block=9) as root:
+        get_logger("consensus").info("round start", block=9)
+        for _ in range(3):  # 1 failure trips it; 2 rejected fallbacks
+            assert DV.agg_verify_on_device(
+                table, [1, 1, 1, 0], payload, agg.point
+            )
+    dumps = [json.load(open(p)) for p in trace.dumps()]
+    opens = [d for d in dumps if d["kind"] == "breaker_open"]
+    assert len(opens) == 1, [d["kind"] for d in dumps]
+    dump = opens[0]
+    assert dump["trace_id"] == root.trace_id
+    span_names = {s["name"] for s in dump["spans"]}
+    assert "consensus.round" in span_names
+    assert "device.dispatch" in span_names
+    assert any(r["msg"] == "round start" for r in dump["logs"])
+    assert all(r["trace_id"] == root.trace_id for r in dump["logs"])
+
+
+# -- THE acceptance scenario: one round, one trace, four components ----------
+
+
+CHAIN_ID = 2
+
+
+def _traced_localnet(n_nodes, sidecar_address):
+    """In-process localnet whose chains verify seals through an engine
+    backed by the verification sidecar — the full deployment vertical:
+    consensus gossip -> device-path quorum checks -> sidecar-verified
+    insert."""
+    from harmony_tpu.chain.engine import Engine, EpochContext
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+    from harmony_tpu.sidecar.client import SidecarClient
+
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=n_nodes)
+    committee = [k.pub.bytes for k in bls_keys]
+    net = InProcessNetwork()
+    nodes, clients = [], []
+    for i in range(n_nodes):
+        client = SidecarClient(sidecar_address)
+        clients.append(client)
+        engine = Engine(
+            lambda s, e, c=committee: EpochContext(c),
+            device=False, backend=client,
+        )
+        chain = Blockchain(MemKV(), genesis, engine=engine,
+                           blocks_per_epoch=16)
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        reg = Registry(
+            blockchain=chain, txpool=pool, host=net.host(f"node{i}")
+        )
+        nodes.append(Node(reg, PrivateKeys.from_keys([bls_keys[i]])))
+    return nodes, clients
+
+
+def _pump(nodes, rounds=50):
+    for _ in range(rounds):
+        if not any(n.process_pending() for n in nodes):
+            break
+
+
+def test_localnet_round_yields_one_multicomponent_trace(forced_device):
+    """A localnet FBFT round under the forced device path produces a
+    SINGLE trace_id whose Chrome trace-event export contains nested
+    spans from >= 4 components (consensus phase, device dispatch,
+    sidecar call, block finalize), served as valid JSON over
+    /debug/trace."""
+    import http.client
+
+    from harmony_tpu.metrics import MetricsServer, Registry
+    from harmony_tpu.sidecar.server import SidecarServer
+
+    trace.configure(enabled=True)
+    sidecar = SidecarServer().start()
+    nodes, clients = _traced_localnet(4, sidecar.address)
+    try:
+        leader = next(n for n in nodes if n.is_leader)
+        leader.start_round_if_leader()
+        _pump(nodes)
+        assert all(n.chain.head_number == 1 for n in nodes)
+
+        root_id = None
+        rounds = [s for s in trace.spans()
+                  if s.name == "consensus.round"]
+        assert len(rounds) == 1  # ONE round root span
+        root_id = rounds[0].trace_id
+        spans = trace.spans(root_id)
+        comps = {s.component for s in spans}
+        assert {"consensus", "device", "sidecar", "chain"} <= comps, comps
+        names = {s.name for s in spans}
+        assert {"consensus.round", "consensus.phase.announce",
+                "consensus.phase.prepare_quorum",
+                "consensus.phase.commit_quorum", "device.dispatch",
+                "sidecar.call", "sidecar.serve",
+                "chain.finalize"} <= names, names
+        # proper nesting, no orphans
+        ids = {s.span_id for s in spans}
+        assert all(s.parent_id in ids for s in spans if s.parent_id)
+        # every consensus-path span shares THE round's trace id: the
+        # device/sidecar/chain work of this round joined one trace
+        strays = [
+            s for s in trace.spans()
+            if s.trace_id != root_id and s.component in
+            ("consensus", "device", "chain")
+        ]
+        assert not strays, [(s.name, s.attrs) for s in strays]
+
+        # the export is valid Chrome trace-event JSON over HTTP
+        mreg = Registry()
+        msrv = MetricsServer(mreg, port=0).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", msrv.port, timeout=10
+            )
+            conn.request("GET", f"/debug/trace?trace_id={root_id}")
+            body = conn.getresponse().read()
+            conn.close()
+            doc = json.loads(body)
+        finally:
+            msrv.stop()
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == len(spans)
+        for e in events:
+            assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+        # every non-root event's parent exists in the export, and no
+        # child STARTS before its parent (message-passing children may
+        # legitimately OUTLIVE their parent span, so containment of
+        # end times is not asserted)
+        by_id = {e["args"]["span_id"]: e for e in events}
+        for e in events:
+            pid = e["args"].get("parent_id")
+            if pid is None:
+                continue
+            assert pid in by_id
+            assert by_id[pid]["ts"] <= e["ts"] + 1e-3
+    finally:
+        for c in clients:
+            c.close()
+        for n in nodes:
+            n.stop()
+        sidecar.stop()

@@ -13,6 +13,12 @@
 #      flapping-backend/black-holed-peer scenarios).  Deterministic by
 #      construction: faults are counted, jitter is hashed, breaker
 #      clocks are injected — no RNG seed to pin.
+#   4. observability smoke — one localnet round under the forced
+#      device path (twin kernels + sidecar-verified seals), then the
+#      tracer tier tests and tools/obs_smoke.py, which scrapes
+#      /metrics + /debug/trace over HTTP and validates the Prometheus
+#      exposition grammar and the Chrome trace-event JSON schema
+#      (names/ts/dur/pid/tid, spans properly parented).
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -35,5 +41,11 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_resilience.py \
   tests/test_chaos.py
+
+echo "== observability smoke: tracer tier + /metrics + /debug/trace =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_trace.py
+JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 echo "check.sh: OK"
