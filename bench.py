@@ -58,6 +58,24 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _error_to_file(err: str, name: str):
+    """(one-line reason, file path) for a failure record: the JSON line
+    carries a readable single line, the full traceback goes to a file
+    next to this script — multi-KB tracebacks were drowning the bench
+    record's ``extra`` (ISSUE 5 satellite)."""
+    lines = [ln for ln in err.strip().splitlines() if ln.strip()]
+    reason = (lines[-1] if lines else err)[:200]
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f"bench_{name}.log"
+    )
+    try:
+        with open(path, "w") as f:
+            f.write(err)
+    except OSError:
+        path = None
+    return reason, path
+
+
 def _honest_zero(err: str, extra=None):
     _emit(
         {
@@ -144,7 +162,10 @@ def main():
     result2, err2 = _run_child(force_cpu=True, timeout_s=remaining)
     if result2 is not None:
         extra = result2.setdefault("extra", {})
-        extra["tpu_attempt_error"] = err1[-500:]
+        reason, detail = _error_to_file(err1, "tpu_attempt_error")
+        extra["tpu_attempt_error"] = reason
+        if detail:
+            extra["tpu_attempt_error_file"] = detail
         extra["relay_tcp"] = relay
         _emit(result2)
         return 0
@@ -383,8 +404,7 @@ def _child_cpu_bigint(extra, deadline):
     _g2mul = NB.g2_mul if native else g2.mul
     sigs = [_g2mul(h_pt, sk) for sk in sks]
 
-    for n_keys, label in ((250, "agg_verify_p50_ms_host"),
-                          (1000, "agg_verify_p50_ms_host_1k")):
+    for n_keys, label in ((250, "agg_verify_p50_ms_host"),):
         try:
             lat = []
             for _ in range(3):
@@ -397,14 +417,90 @@ def _child_cpu_bigint(extra, deadline):
                     break
             p50 = sorted(lat)[len(lat) // 2]
             extra[label] = round(p50 * 1e3, 1)
-            if n_keys == 250:
-                extra["agg_verify_n_keys"] = n_keys
-                # replay throughput floor: one seal check per header
-                extra["replay_headers_per_sec_host"] = round(1.0 / p50, 2)
+            extra["agg_verify_n_keys"] = n_keys
+            # replay throughput floor: one seal check per header
+            extra["replay_headers_per_sec_host"] = round(1.0 / p50, 2)
         except Exception as e:  # noqa: BLE001
             extra["configs_failed"].append(
                 f"agg_verify_host_{n_keys}: {e!r:.300}"
             )
+
+    # config #2 at the 1000-key target, measured THROUGH the
+    # verification scheduler under concurrent replay load (ISSUE 5):
+    # twin kernels force the device-path layers onto this host crypto,
+    # a background thread streams 8-wide replay batches down the sync
+    # lane, and the recorded p50 is the CONSENSUS lane's — with the
+    # batch fill ratio alongside, so the round captures the
+    # continuous-batching behavior (fill/latency), not just kernel
+    # speed.  The old inline-1k number measured the same pairing with
+    # no queue in front of it.
+    try:
+        import threading as _th
+
+        os.environ["HARMONY_KERNEL_TWIN"] = "1"
+        from harmony_tpu import device as DV
+        from harmony_tpu import sched as SC
+        from harmony_tpu.sched.scheduler import FILL as _FILL
+
+        DV.use_device(True)
+        try:
+            table_1k = DV.CommitteeTable(pks)
+            table_replay = DV.CommitteeTable(pks[:250])
+            agg_1k = RB.aggregate_sigs(sigs)
+            agg_replay = RB.aggregate_sigs(sigs[:250])
+            bits_1k, bits_replay = [1] * n_max, [1] * 250
+            items0, slots0 = _FILL["items"], _FILL["slots"]
+            stop = _th.Event()
+
+            def replay_load():
+                while not stop.is_set() and _t.monotonic() < deadline:
+                    futs = [
+                        SC.scheduler().submit_agg(
+                            table_replay, bits_replay, h_pt, agg_replay,
+                            lane=SC.Lane.SYNC,
+                        )
+                        for _ in range(8)
+                    ]
+                    for f in futs:
+                        try:
+                            f.result(120)
+                        except Exception:  # noqa: BLE001 — bench load
+                            return
+
+            loader = _th.Thread(target=replay_load, daemon=True)
+            loader.start()
+            lat = []
+            for _ in range(7):
+                t1 = _t.perf_counter()
+                ok = SC.agg_verify(table_1k, bits_1k, msg, agg_1k,
+                                   lane=SC.Lane.CONSENSUS)
+                lat.append(_t.perf_counter() - t1)
+                assert ok, "scheduled 1k agg_verify rejected a quorum!"
+                if _t.monotonic() > deadline:
+                    break
+            stop.set()
+            loader.join(timeout=30)
+            extra["agg_verify_p50_ms_host_1k"] = round(
+                sorted(lat)[len(lat) // 2] * 1e3, 1
+            )
+            # the key predates ISSUE 5 but the MEASUREMENT changed in
+            # r06: through the scheduler, twin kernels, under replay
+            # load — mark it so trend diffs read a redefinition, not a
+            # host-crypto regression
+            extra["agg_verify_1k_mode"] = "sched_mixed_lane_twin"
+            d_items = _FILL["items"] - items0
+            d_slots = _FILL["slots"] - slots0
+            if d_slots:
+                extra["sched_batch_fill_ratio"] = round(
+                    d_items / d_slots, 3
+                )
+            extra["sched_items_dispatched"] = d_items
+        finally:
+            SC.reset()
+            DV.use_device(None)
+            os.environ.pop("HARMONY_KERNEL_TWIN", None)
+    except Exception as e:  # noqa: BLE001
+        extra["configs_failed"].append(f"agg_verify_sched_1k: {e!r:.300}")
 
     # primary: raw host pairing throughput (full pairing incl. final exp)
     if native:

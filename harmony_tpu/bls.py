@@ -116,20 +116,28 @@ def aggregate_sigs(sigs) -> Signature:
     return Signature(RB.aggregate_sigs([s.point for s in sigs]))
 
 
-def verify_point(pk_point, payload: bytes, sig_point) -> bool:
-    """One aggregate-signature check, routed to the TPU ops when the
-    device path is live (device.device_enabled()) and to the host
-    bigint twin otherwise — THE verification choke point every
-    consensus check funnels through."""
+def verify_point(pk_point, payload: bytes, sig_point, *,
+                 lane=None) -> bool:
+    """One aggregate-signature check, routed through the verification
+    scheduler's shared device queue when the device path is live
+    (device.device_enabled()) and to the host bigint twin otherwise —
+    THE verification choke point every consensus check funnels
+    through.  ``lane`` picks the scheduler priority lane (default:
+    consensus — vote/proof checks gate live rounds)."""
     from . import device as DV
 
     if DV.device_enabled():
-        return DV.verify_on_device(pk_point, payload, sig_point)
+        from . import sched
+
+        return sched.verify_single(
+            pk_point, payload, sig_point,
+            lane=sched.Lane.CONSENSUS if lane is None else lane,
+        )
     return RB.verify(pk_point, payload, sig_point)
 
 
 def verify_aggregate_bytes(
-    pubkeys_bytes, payload: bytes, sig_bytes: bytes
+    pubkeys_bytes, payload: bytes, sig_bytes: bytes, *, lane=None
 ) -> bool:
     """Verify a 96-byte signature against the SUM of serialized pubkeys —
     the shape every multi-key vote check takes (consensus votes,
@@ -145,7 +153,70 @@ def verify_aggregate_bytes(
         sig = Signature.from_bytes(sig_bytes)
     except (ValueError, KeyError):
         return False
-    return verify_point(agg_pk.point, payload, sig.point)
+    return verify_point(agg_pk.point, payload, sig.point, lane=lane)
+
+
+def proof_of_possession(priv: "PrivateKey") -> bytes:
+    """BLS proof-of-possession: the key signs its own serialized public
+    key (the reference's staking_verifier.go VerifyBLSKeys contract) —
+    carried in create-validator / add-bls-key staking txs and checked
+    at pool admission on the scheduler's ingress lane."""
+    return priv.sign_hash(priv.pub.bytes).bytes
+
+
+def verify_proof_of_possession(pub_bytes: bytes, sig_bytes: bytes, *,
+                               lane=None) -> bool:
+    """Check one key's proof-of-possession; malformed input returns
+    False, never raises."""
+    return verify_proofs_of_possession([(pub_bytes, sig_bytes)],
+                                       lane=lane)
+
+
+def verify_proofs_of_possession(pairs, *, lane=None) -> bool:
+    """Check many (pubkey bytes, pop signature bytes) pairs: on the
+    live device path every check is SUBMITTED to the scheduler before
+    the first is awaited, so a multi-key create-validator (or a burst
+    of staking submits) coalesces into one fused batch instead of N
+    sequential round-trips.  False on any malformed or failing pair,
+    never raises."""
+    from . import device as DV
+
+    decoded = []
+    try:
+        for pub_bytes, sig_bytes in pairs:
+            pk = pubkey_from_bytes_cached(pub_bytes)
+            sig = Signature.from_bytes(sig_bytes)
+            if sig.point is None:
+                return False
+            decoded.append((pk.point, bytes(pub_bytes), sig.point))
+    except (ValueError, KeyError):
+        return False
+    if not decoded:
+        return True
+    if DV.device_enabled():
+        from . import sched
+
+        if sched.enabled():
+            from .ref.hash_to_curve import hash_to_g2
+
+            s = sched.scheduler()
+            use_lane = sched.Lane.INGRESS if lane is None else lane
+            futures = [
+                s.submit_single(pk, hash_to_g2(payload), sig,
+                                lane=use_lane)
+                for pk, payload, sig in decoded
+            ]
+            try:
+                return all(f.result() for f in futures)
+            except (RuntimeError, OSError):
+                # scheduler stopped / deadline surfaced mid-await: an
+                # unverifiable proof is a REJECTED proof — this
+                # function never raises into admission paths
+                return False
+    return all(
+        verify_point(pk, payload, sig, lane=lane)
+        for pk, payload, sig in decoded
+    )
 
 
 @functools.lru_cache(maxsize=1024)
@@ -164,7 +235,9 @@ __all__ = [
     "PrivateKey",
     "Signature",
     "aggregate_sigs",
+    "proof_of_possession",
     "pubkey_from_bytes_cached",
+    "verify_proof_of_possession",
     "PUBKEY_BYTES",
     "SIG_BYTES",
 ]

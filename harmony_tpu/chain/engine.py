@@ -131,15 +131,21 @@ class Engine:
         self.backend = backend
         self._backend_committees: set = set()  # (shard, epoch) pushed
 
-    def _backend_verify(self, ctx: EpochContext, header: Header,
-                        payload: bytes, sig_bytes: bytes,
-                        bitmap: bytes) -> bool:
+    def _ensure_backend_committee(self, ctx: EpochContext,
+                                  header: Header) -> None:
+        """Push (shard, epoch)'s committee to the sidecar exactly once
+        per engine lifetime (the client replays it on reconnect)."""
         key = (header.shard_id, header.epoch)
         if key not in self._backend_committees:
             self.backend.set_committee(
                 header.epoch, header.shard_id, list(ctx.serialized)
             )
             self._backend_committees.add(key)
+
+    def _backend_verify(self, ctx: EpochContext, header: Header,
+                        payload: bytes, sig_bytes: bytes,
+                        bitmap: bytes) -> bool:
+        self._ensure_backend_committee(ctx, header)
         return self.backend.agg_verify(
             header.epoch, header.shard_id, payload, bitmap, sig_bytes
         )
@@ -169,9 +175,12 @@ class Engine:
 
     def verify_header_signature(
         self, header: Header, sig_bytes: bytes, bitmap: bytes,
-        is_staking: bool = True,
+        is_staking: bool = True, lane=None,
     ) -> bool:
-        """One header's aggregate commit check (engine.go:576-642)."""
+        """One header's aggregate commit check (engine.go:576-642).
+        ``lane`` picks the verification scheduler's priority lane
+        (default: the sync lane — replay is the engine's home turf;
+        the node's live-commit path passes CONSENSUS)."""
         cache_key = (header.hash(), sig_bytes, bitmap)
         if cache_key in self._verified:
             return True
@@ -192,12 +201,14 @@ class Engine:
         if self.device:
             # fused path: committee table stays device-resident; the
             # masked G1 tree-sum AND the pairing check run as ONE
-            # program — no host affine round-trip (the r2 path paid
-            # two dispatches + a host conversion per check)
-            from .. import device as DV
+            # program, submitted through the shared verification
+            # scheduler so concurrent callers coalesce into the
+            # pinned buckets instead of interleaving lone dispatches
+            from .. import sched
 
-            ok = DV.agg_verify_on_device(
-                ctx.committee_table(), mask.bit_vector(), payload, sig
+            ok = sched.agg_verify(
+                ctx.committee_table(), mask.bit_vector(), payload, sig,
+                lane=sched.Lane.SYNC if lane is None else lane,
             )
         else:
             agg_pk = mask.aggregate_public(device=False)
@@ -210,22 +221,23 @@ class Engine:
         return True
 
     def verify_seal(self, header: Header, child: Header,
-                    is_staking: bool = True) -> bool:
+                    is_staking: bool = True, lane=None) -> bool:
         """Verify header via the commit proof its CHILD carries
         (engine.go:237-262 VerifySeal)."""
         return self.verify_header_signature(
             header, child.last_commit_sig, child.last_commit_bitmap,
-            is_staking,
+            is_staking, lane=lane,
         )
 
     # --- the batched replay path ------------------------------------------
 
     def verify_headers_batch(
-        self, items: list, is_staking=True
+        self, items: list, is_staking=True, lane=None
     ) -> list:
         """items: [(header, sig_bytes, bitmap)].  All masked committee
         aggregations and pairing checks run as ONE device program — the
-        throughput path for chain replay (BASELINE config #5).
+        throughput path for chain replay (BASELINE config #5) — routed
+        through the verification scheduler's sync lane (or ``lane``).
 
         Committees may differ per header (cross-epoch batches are fine);
         quorum checks and payload construction stay host-side exactly as
@@ -243,24 +255,28 @@ class Engine:
         if len(flags) != len(items):
             raise ValueError("is_staking list length != items length")
         if self.backend is not None:
-            # out-of-process verification service: the sidecar holds
-            # the committee device-resident, so each check ships only
-            # O(bitmap + 96 B); until the protocol grows a batched
-            # AGG_VERIFY this loops the per-header path (which also
-            # carries the verified-sig cache and trace propagation).
-            # Before this route the insert/replay path silently IGNORED
-            # a wired backend and verified in-process.
-            return [
-                self.verify_header_signature(h, s, b, flags[i])
-                for i, (h, s, b) in enumerate(items)
-            ]
+            from .. import sched
+
+            if not sched.enabled():
+                # pre-scheduler behavior: the per-header path (which
+                # also carries the verified-sig cache and retries)
+                return [
+                    self.verify_header_signature(h, s, b, flags[i],
+                                                 lane=lane)
+                    for i, (h, s, b) in enumerate(items)
+                ]
         results = [False] * len(items)
         # survivors grouped by committee context: each group runs as one
         # fused device batch (bitmaps + hashed payloads + sigs in, bools
         # out — the masked aggregations happen ON DEVICE, not as N
-        # host G1 adds per header as in r2)
+        # host G1 adds per header as in r2).  The sidecar-backend path
+        # shares this loop: its survivors pipeline over the wire via
+        # the scheduler instead of serializing one round-trip per
+        # header (the old per-header fallback made a cross-epoch batch
+        # cost N round-trips).
         groups: dict = {}  # id(ctx) -> (ctx, [(idx, bits, h_pt, sig)])
         host_survivors = []  # (idx, agg_pk, h_pt, sig) — host path only
+        backend_calls = []  # (idx, header, ctx, payload) — sidecar path
         for idx, (header, sig_bytes, bitmap) in enumerate(items):
             cache_key = (header.hash(), sig_bytes, bitmap)
             if cache_key in self._verified:
@@ -274,6 +290,9 @@ class Engine:
             if not ctx.decider.is_quorum_achieved_by_mask(mask.bit_vector()):
                 continue
             payload = self._commit_payload(header, flags[idx])
+            if self.backend is not None:
+                backend_calls.append((idx, header, ctx, payload))
+                continue
             h_pt = hash_to_g2(payload)
             if self.device:
                 groups.setdefault(id(ctx), (ctx, []))[1].append(
@@ -284,6 +303,10 @@ class Engine:
                 if agg_pk is None:
                     continue
                 host_survivors.append((idx, agg_pk, h_pt, sig))
+        if self.backend is not None:
+            return self._backend_verify_batch(
+                items, flags, results, backend_calls, lane
+            )
         if not self.device:
             for idx, agg_pk, h_pt, sig in host_survivors:
                 if RB.verify_hashed(agg_pk, h_pt, sig):
@@ -291,18 +314,56 @@ class Engine:
                     header, sig_bytes, bitmap = items[idx]
                     self._verified.put((header.hash(), sig_bytes, bitmap))
             return results
-        from .. import device as DV
+        from .. import sched
 
         for ctx, entries in groups.values():
-            ok = DV.agg_verify_batch_on_device(
+            ok = sched.agg_verify_many(
                 ctx.committee_table(),
                 [e[1] for e in entries],
                 [e[2] for e in entries],
                 [e[3] for e in entries],
+                lane=sched.Lane.SYNC if lane is None else lane,
             )
             for (idx, _, _, _), good in zip(entries, ok):
                 if good:
                     results[idx] = True
                     header, sig_bytes, bitmap = items[idx]
                     self._verified.put((header.hash(), sig_bytes, bitmap))
+        return results
+
+    def _backend_verify_batch(self, items, flags, results,
+                              backend_calls, lane):
+        """Sidecar remainder of a (possibly cross-epoch) batch: push
+        any missing committees once, then pipeline EVERY check through
+        the scheduler's backend worker — all frames on the wire before
+        the first reply is awaited.  A failed pipelined call (sidecar
+        restart mid-batch, unknown committee) falls back per-item to
+        the resilient ``verify_header_signature`` path, which redials
+        and replays committees."""
+        from .. import sched
+
+        for _, header, ctx, _ in backend_calls:
+            self._ensure_backend_committee(ctx, header)
+        futures = sched.backend_agg_verify_many(
+            self.backend,
+            [
+                (header.epoch, header.shard_id, payload,
+                 items[idx][2], items[idx][1])
+                for idx, header, _, payload in backend_calls
+            ],
+            lane=sched.Lane.SYNC if lane is None else lane,
+        )
+        for (idx, header, _, _), fut in zip(backend_calls, futures):
+            _, sig_bytes, bitmap = items[idx]
+            try:
+                ok = fut.result()
+            except Exception:  # noqa: BLE001 — degrade per item to the
+                # retrying per-header path; ITS failure propagates
+                results[idx] = self.verify_header_signature(
+                    header, sig_bytes, bitmap, flags[idx], lane=lane
+                )
+                continue
+            if ok:
+                results[idx] = True
+                self._verified.put((header.hash(), sig_bytes, bitmap))
         return results

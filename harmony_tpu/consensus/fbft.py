@@ -242,7 +242,10 @@ class Validator(_Node):
 
         Device path: the committee lives as one device-resident table
         and the masked aggregation + pairing check run FUSED as a
-        single program (ops/bls.agg_verify) — bitmap in, bool out."""
+        single program (ops/bls.agg_verify) — submitted through the
+        verification scheduler's CONSENSUS lane, so a proof check
+        rides the shared device queue ahead of sync/ingress traffic
+        (and coalesces with any concurrent same-committee checks)."""
         from .. import device as DV
 
         try:
@@ -257,11 +260,14 @@ class Validator(_Node):
         except ValueError:
             return False
         if DV.device_enabled():
+            from .. import sched
+
             table = DV.get_committee_table(
                 self.cfg.committee, self.committee_points
             )
-            return DV.agg_verify_on_device(
-                table, mask.bit_vector(), payload, sig.point
+            return sched.agg_verify(
+                table, mask.bit_vector(), payload, sig.point,
+                lane=sched.Lane.CONSENSUS,
             )
         agg_pk = mask.aggregate_public(device=False)
         if agg_pk is None:

@@ -172,10 +172,15 @@ def sign_message(msg: FBFTMessage, keys) -> FBFTMessage:
     return msg
 
 
-def verify_sender_sig(msg: FBFTMessage) -> bool:
+def verify_sender_sig(msg: FBFTMessage, *, lane=None) -> bool:
     """The ingress gate (reference: consensus/checks.go verifySenderKey
     + message-signature verification): the claimed sender keys must
-    have signed THIS exact message.  Malformed input returns False."""
+    have signed THIS exact message.  Malformed input returns False.
+
+    ``lane`` picks the verification scheduler's priority lane; the
+    node's gossip pump passes the INGRESS lane (per-message admission
+    work — a forged flood must queue behind, never ahead of, the
+    round's quorum proofs)."""
     from .. import bls as B
     from ..ref.keccak import keccak256
 
@@ -186,7 +191,7 @@ def verify_sender_sig(msg: FBFTMessage) -> bool:
     except ValueError:
         return False
     return B.verify_aggregate_bytes(
-        msg.sender_pubkeys, digest, msg.sender_sig
+        msg.sender_pubkeys, digest, msg.sender_sig, lane=lane
     )
 
 

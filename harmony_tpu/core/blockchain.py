@@ -337,12 +337,14 @@ class Blockchain:
         return seen
 
     def _resolve_and_verify(self, blocks, commit_sigs, parent,
-                            verify_seals):
+                            verify_seals, lane=None):
         """Shared insert front-half (replay and fast-sync paths):
         structural checks against ``parent``, commit-proof resolution
         (blocks[i+1]'s carried header proof fills a None — the replay
         pattern, sig_verify.go:37-48), and ONE batched seal
         verification across the window.  Returns (blocks, proofs).
+        ``lane`` is the verification-scheduler priority lane for the
+        seal batch (None = the engine's default, the sync lane).
         """
         if commit_sigs is None:
             commit_sigs = [None] * len(blocks)
@@ -369,7 +371,7 @@ class Blockchain:
                 sig, bitmap = proof[:96], proof[96:]
                 items.append((block.header, sig, bitmap))
                 flags.append(self.config.is_staking(block.header.epoch))
-            ok = self.engine.verify_headers_batch(items, flags)
+            ok = self.engine.verify_headers_batch(items, flags, lane=lane)
             for block, good in zip(blocks, ok):
                 if not good:
                     raise ChainError(
@@ -490,22 +492,25 @@ class Blockchain:
         rawdb.write_receipts(self.db, num, receipts)
 
     def insert_chain(self, blocks: list, commit_sigs: list | None = None,
-                     verify_seals: bool = True) -> int:
+                     verify_seals: bool = True, lane=None) -> int:
         """Insert consecutive blocks; returns how many were inserted.
 
         ``commit_sigs[i]`` is the [96B sig || bitmap] proof for
         blocks[i]; where None, the proof is taken from blocks[i+1]'s
         header (the replay pattern — sig_verify.go:37-48).  Seal
-        verification is batched across the insert through the engine.
+        verification is batched across the insert through the engine;
+        ``lane`` picks the scheduler lane (the consensus commit path
+        passes its CONSENSUS lane, replay/sync take the default).
         """
         if not blocks:
             return 0
         with self._insert_lock:
             return self._insert_chain_locked(
-                blocks, commit_sigs, verify_seals
+                blocks, commit_sigs, verify_seals, lane
             )
 
-    def _insert_chain_locked(self, blocks, commit_sigs, verify_seals):
+    def _insert_chain_locked(self, blocks, commit_sigs, verify_seals,
+                             lane=None):
         if commit_sigs is None:
             commit_sigs = [None] * len(blocks)
 
@@ -522,7 +527,8 @@ class Blockchain:
         commit_sigs = [s for _, s in pairs]
 
         blocks, proofs = self._resolve_and_verify(
-            blocks, commit_sigs, self.current_header(), verify_seals
+            blocks, commit_sigs, self.current_header(), verify_seals,
+            lane,
         )
 
         # execution + persistence pass

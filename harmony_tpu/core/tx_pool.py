@@ -117,6 +117,51 @@ class TxPool:
         except ValueError as e:
             raise PoolError(f"bad signature: {e}") from e
 
+    @staticmethod
+    def _verify_bls_pop(tx) -> None:
+        """BLS proof-of-possession check for staking txs that register
+        keys (create-validator's ``bls_key_sigs`` aligned with
+        ``bls_keys``; edit-validator's ``add_bls_key_sig``): each key
+        must have signed its own serialized bytes (the reference's
+        staking_verifier.go VerifyBLSKeys).  Runs OUTSIDE the pool
+        lock, submitted on the verification scheduler's INGRESS lane —
+        a burst of staking submits coalesces into one fused device
+        batch instead of each paying an inline pairing.  Raises
+        PoolError on an invalid or mis-aligned proof.  Proof fields
+        are OPT-IN on the wire: legacy txs without them still admit
+        (the execution layer's rules are unchanged); a tx that carries
+        them is held to them.  Txs without key material (delegate,
+        undelegate, ...) pass untouched."""
+        fields = getattr(tx, "fields", None)
+        if not isinstance(fields, dict):
+            return
+        pairs = []  # (pubkey bytes, pop signature bytes)
+        keys = fields.get("bls_keys")
+        sigs = fields.get("bls_key_sigs")
+        if keys and sigs is not None:
+            if isinstance(keys, bytes):  # packed 48-byte keys
+                keys = [keys[i:i + 48] for i in range(0, len(keys), 48)]
+            if isinstance(sigs, bytes):  # packed 96-byte sigs
+                sigs = [sigs[i:i + 96] for i in range(0, len(sigs), 96)]
+            if len(sigs) != len(keys):
+                raise PoolError("bls_key_sigs/bls_keys length mismatch")
+            pairs.extend(zip(keys, sigs))
+        added = fields.get("add_bls_key")
+        pop = fields.get("add_bls_key_sig")
+        if added is not None and pop is not None:
+            pairs.append((added, pop))
+        if not pairs:
+            return
+        from .. import bls as B
+        from .. import sched
+
+        # all proofs submitted before any is awaited: a multi-key
+        # registration coalesces into one fused scheduler batch
+        if not B.verify_proofs_of_possession(
+            pairs, lane=sched.Lane.INGRESS
+        ):
+            raise PoolError("bad BLS key proof of possession")
+
     def _validate(self, tx, is_staking: bool,
                   sender: bytes | None = None) -> bytes:
         if sender is None:
@@ -292,6 +337,11 @@ class TxPool:
         # recover the signature BEFORE taking the lock: it is the
         # dominant cost of admission and needs no pool state
         sender = self._recover_sender(tx)
+        if is_staking:
+            # BLS key-registration proofs verify OUTSIDE the lock too,
+            # on the scheduler's ingress lane (PR 2 hoisted the ECDSA
+            # recover; these pairings were the remaining inline crypto)
+            self._verify_bls_pop(tx)
         with self._lock:
             sender = self._add_unlocked(tx, is_staking, sender)
             if local:

@@ -406,7 +406,17 @@ def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
     reference host path."""
     from .ref.hash_to_curve import hash_to_g2
 
-    h = hash_to_g2(payload)
+    return agg_verify_hashed_on_device(
+        table, bits, hash_to_g2(payload), sig_point
+    )
+
+
+def agg_verify_hashed_on_device(table: CommitteeTable, bits, h_point,
+                                sig_point) -> bool:
+    """``agg_verify_on_device`` with the payload already hashed to G2 —
+    the shape the scheduler submits (hash-to-curve runs on the
+    submitting thread, never on the shared flush thread)."""
+    h = h_point
     COUNTERS.inc("agg_verify")
 
     def dispatch() -> bool:
@@ -613,3 +623,95 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
         return RB.verify_hashed(pk_point, h, sig_point)
 
     return _guarded("verify", dispatch, fallback)
+
+
+def verify_many_on_device(pk_points, h_points, sig_points) -> list:
+    """N *independent* single checks — distinct keys, distinct payload
+    points — fused into pinned-width ``verify`` programs: the
+    continuous-batching shape the scheduler feeds with coalesced
+    tx-pool / RPC / sender-sig traffic (each of which used to pay a
+    full dispatch round-trip alone).  h_points are pre-hashed payload
+    G2 points.  Pad lanes are affine infinity (sliced off before
+    return).  Breaker-guarded; fallback re-checks each lane on the
+    host bigint path."""
+    n_total = len(pk_points)
+    COUNTERS.inc("verify", n_total)
+
+    def dispatch():
+        import numpy as np
+
+        from .ops import interop as I
+
+        if kernel_twin_active():
+            asarray = np.asarray
+            OB = None  # twins only: jax stays unloaded
+        else:
+            import jax.numpy as jnp
+
+            from .ops import bls as OB
+
+            asarray = jnp.asarray
+        fused = _fused()
+        fn = _get_verify_fn() if fused else OB.verify
+        widest = batch_buckets()[-1]
+        results = []
+        # dispatch every chunk before syncing any result (the GL07
+        # stream discipline agg_verify_batch_on_device established)
+        pending = []  # (ok device array, live lane count)
+        h2d = 0
+        compiles = []  # (program, first-dispatch seconds)
+        for start in range(0, n_total, widest):
+            chunk_pk = pk_points[start:start + widest]
+            chunk_h = h_points[start:start + widest]
+            chunk_s = sig_points[start:start + widest]
+            n = len(chunk_pk)
+            padded = batch_bucket(n) if fused else n
+            pad = padded - n
+            pk = np.asarray(I.g1_batch_affine(chunk_pk))
+            hh = np.asarray(I.g2_batch_affine(chunk_h))
+            sg = np.asarray(I.g2_batch_affine(chunk_s))
+            if pad:
+                # pad with affine infinity: the twins short-circuit
+                # those lanes and the kernels' pad output is sliced off
+                pk = np.concatenate(
+                    [pk, np.zeros((pad,) + pk.shape[1:], pk.dtype)]
+                )
+                hh = np.concatenate(
+                    [hh, np.zeros((pad,) + hh.shape[1:], hh.dtype)]
+                )
+                sg = np.concatenate(
+                    [sg, np.zeros((pad,) + sg.shape[1:], sg.dtype)]
+                )
+            h2d += pk.nbytes + hh.nbytes + sg.nbytes
+            program = f"verify_w{padded}"
+            first = _program_first_use(program) if fused else False
+            t0 = time.monotonic()
+            ok = fn(asarray(pk), asarray(hh), asarray(sg))
+            if first:
+                compiles.append((program, time.monotonic() - t0))
+            pending.append((ok, n))
+        TRANSFER.inc("h2d", h2d)
+        d2h = 0
+        for ok, n in pending:
+            # all programs are in flight; this loop only drains results
+            flat = np.asarray(ok)  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
+            d2h += flat.nbytes
+            results.extend(bool(x) for x in flat[:n])
+        TRANSFER.inc("d2h", d2h)
+        for program, dur in compiles:
+            JIT_COMPILE_SECONDS.set(dur, program=program)
+        trace.annotate(
+            chunks=len(pending), checks=n_total,
+            jit_compiles=len(compiles), h2d_bytes=h2d, d2h_bytes=d2h,
+        )
+        return results
+
+    def fallback():
+        from .ref import bls as RB
+
+        return [
+            RB.verify_hashed(pk, h, sig)
+            for pk, h, sig in zip(pk_points, h_points, sig_points)
+        ]
+
+    return _guarded("verify_many", dispatch, fallback)

@@ -107,9 +107,14 @@ class Histogram:
         0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
     )
 
-    def __init__(self, name: str, help_: str = "", buckets=None):
+    def __init__(self, name: str, help_: str = "", buckets=None,
+                 labels: dict | None = None):
+        """``labels``: constant label set stamped on every sample line
+        (the scheduler keeps one Histogram per lane under one family
+        name this way — the module has no dynamic label indexing)."""
         self.name, self.help = name, help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.labels = dict(labels or {})
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
@@ -128,15 +133,22 @@ class Histogram:
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
+        base = _fmt_labels(self.labels)
         with self._lock:
             cum = 0
             for b, c in zip(self.buckets, self._counts):
                 cum += c
-                lines.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**self.labels, 'le': f'{b:g}'})} {cum}"
+                )
             cum += self._counts[-1]
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{self.name}_sum {self._sum:g}")
-            lines.append(f"{self.name}_count {self._total}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels({**self.labels, 'le': '+Inf'})} {cum}"
+            )
+            lines.append(f"{self.name}_sum{base} {self._sum:g}")
+            lines.append(f"{self.name}_count{base} {self._total}")
         return "\n".join(lines)
 
 
@@ -171,6 +183,7 @@ class Registry:
         lines = [m.expose() for m in metrics]
         lines.append(self._device_counters())
         lines.append(self._resilience_counters())
+        lines.append(self._sched_counters())
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -222,6 +235,16 @@ class Registry:
             )
         out.append(DV.JIT_COMPILE_SECONDS.expose())
         return "\n".join(out)
+
+    @staticmethod
+    def _sched_counters() -> str:
+        """Verification-scheduler families (queue depth, per-lane wait,
+        batch fill ratio, sheds) — a localnet run can ASSERT over HTTP
+        that continuous batching actually coalesced (fill ratio) and
+        that the consensus lane never shed (ISSUE 5 acceptance)."""
+        from . import sched
+
+        return sched.expose_metrics()
 
     @staticmethod
     def _resilience_counters() -> str:

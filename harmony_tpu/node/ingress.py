@@ -10,6 +10,13 @@ Behavioral parity with the reference:
   sender key in committee, bitmap length sanity (reference:
   node/harmony/node.go:473-608 validateShardBoundMessage).  The point is
   DoS economy: pairing work only happens for messages that could matter.
+
+The one signature check that IS ingress work — the sender-sig gate on
+messages that survived the cheap filter — runs through the
+verification scheduler's INGRESS lane (``verify_sender``): per-message
+admission crypto coalesces into fused device batches and queues
+*behind* the round's quorum proofs, so a gossip flood cannot starve
+consensus of device time.
 """
 
 from __future__ import annotations
@@ -17,7 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from enum import IntEnum
 
-from ..consensus.messages import FBFTMessage, MsgType
+from ..consensus.messages import FBFTMessage, MsgType, verify_sender_sig
 
 VIEW_ID_WINDOW = 5  # reference: node.go:545-555 (viewID + 5 < current -> drop)
 
@@ -98,3 +105,13 @@ def validate_consensus_message(
         if len(msg.payload) != 96 + expected:
             return IngressResult(False, "bad aggregate payload length")
     return IngressResult(True)
+
+
+def verify_sender(msg: FBFTMessage) -> bool:
+    """The ingress-lane sender-signature gate: the one pairing check a
+    message pays to enter the consensus pump, submitted on the
+    scheduler's INGRESS lane so bursts of gossip coalesce into fused
+    single-verify batches instead of each paying a dispatch alone."""
+    from .. import sched
+
+    return verify_sender_sig(msg, lane=sched.Lane.INGRESS)

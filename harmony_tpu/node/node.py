@@ -31,7 +31,6 @@ from ..consensus.messages import (
     decode_message,
     encode_message,
     sign_message,
-    verify_sender_sig,
 )
 from ..consensus.quorum import Decider, Policy
 from ..consensus.sender import MessageSender
@@ -57,6 +56,7 @@ from .ingress import (
     pack_envelope,
     parse_envelope,
     validate_consensus_message,
+    verify_sender,
 )
 from .worker import Worker
 
@@ -446,8 +446,10 @@ class Node:
         # the sender must have SIGNED this exact message — without this
         # gate any peer could replay/forge another member's ANNOUNCE /
         # PREPARED / COMMITTED (reference verifies the message signature
-        # on every consensus message, consensus/checks.go)
-        if not verify_sender_sig(msg):
+        # on every consensus message, consensus/checks.go).  Runs on
+        # the scheduler's INGRESS lane: admission crypto coalesces and
+        # never queues ahead of the round's quorum proofs.
+        if not verify_sender(msg):
             self.dropped_messages += 1
             trace.annotate(dropped="bad_sender_sig")
             return
@@ -647,9 +649,14 @@ class Node:
         if first is None:
             return
         from .. import bls as B
+        from .. import sched
 
+        # forensics on a rejected ballot is admission work: it must
+        # queue BEHIND the round's quorum proofs (ingress lane), or a
+        # bogus-ballot flood would buy device priority
         if not B.verify_aggregate_bytes(
-            msg.sender_pubkeys, payload_for(msg.block_hash), msg.payload
+            msg.sender_pubkeys, payload_for(msg.block_hash), msg.payload,
+            lane=sched.Lane.INGRESS,
         ):
             return
         evidence = {
@@ -735,9 +742,12 @@ class Node:
         with trace.span("chain.finalize", component="chain",
                         block=block.block_num):
             try:
+                from .. import sched
+
                 self.chain.insert_chain(
                     [block], commit_sigs=[msg.payload],
                     verify_seals=self.chain.engine is not None,
+                    lane=sched.Lane.CONSENSUS,
                 )
             except ChainError as e:
                 trace.annotate(error=str(e))

@@ -46,6 +46,32 @@ class _Pending:
         self.frame: tuple | None = None  # (resp type, body) when set
 
 
+class _AsyncCall:
+    """A pipelined in-flight ``agg_verify``: the frame is already on
+    the wire; ``result()`` awaits the demultiplexed reply."""
+
+    __slots__ = ("_client", "_sock", "_rid", "_slot", "_epoch",
+                 "_shard", "_deadline")
+
+    def __init__(self, client, sock, rid, slot, epoch, shard, deadline):
+        self._client = client
+        self._sock = sock
+        self._rid = rid
+        self._slot = slot
+        self._epoch = epoch
+        self._shard = shard
+        self._deadline = deadline
+
+    def result(self) -> bool:
+        status, body = self._client._await(
+            self._sock, P.MSG_AGG_VERIFY, self._rid, self._slot,
+            self._deadline,
+        )
+        return SidecarClient._agg_verify_result(
+            self._epoch, self._shard, status, body
+        )
+
+
 class SidecarClient:
     def __init__(self, address, connect_timeout: float = 5.0,
                  call_timeout: float = 10.0,
@@ -215,28 +241,42 @@ class SidecarClient:
 
     # -- framed RPC ----------------------------------------------------------
 
-    def _request(self, sock, msg_type: int, body: bytes,
-                 deadline: Deadline):
+    def _begin(self, sock, msg_type: int, body: bytes) -> tuple:
+        """Register a waiter and put the frame on the wire; returns
+        (rid, slot).  The reply wait is separate (``_await``) so
+        callers — notably the scheduler's backend worker — can send a
+        whole batch of frames before awaiting any reply."""
         with self._lock:
             self._req_id += 1
             rid = self._req_id
             slot = _Pending()
             self._pending[rid] = slot
         try:
-            try:
-                # _send_lock only keeps concurrent frames from
-                # interleaving; the response wait below runs with NO
-                # lock held, so calls overlap on the wire
-                with self._send_lock:
-                    sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
-                        P.pack_frame(msg_type, rid, body,
-                                     trace.traceparent())
-                    )
-            except OSError as e:
-                self._drop(sock)
-                raise SidecarUnavailable(
-                    f"sidecar send failed: {e}"
-                ) from e
+            # _send_lock only keeps concurrent frames from
+            # interleaving; the response wait runs with NO lock held,
+            # so calls overlap on the wire
+            with self._send_lock:
+                sock.sendall(  # graftlint: disable=GL06 frame-atomicity lock, held per send, never across the response wait
+                    P.pack_frame(msg_type, rid, body,
+                                 trace.traceparent())
+                )
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+            self._drop(sock)
+            raise SidecarUnavailable(f"sidecar send failed: {e}") from e
+        except BaseException:
+            # e.g. pack_frame's ValueError on an oversized frame:
+            # nothing went on the wire, so the connection is fine —
+            # but the registered waiter must not leak
+            with self._lock:
+                self._pending.pop(rid, None)
+            raise
+        return rid, slot
+
+    def _await(self, sock, msg_type: int, rid: int, slot: "_Pending",
+               deadline: Deadline):
+        try:
             if not slot.event.wait(deadline.bound(self._call_timeout)):
                 self._drop(sock)  # wedged sidecar: fail closed, redial
                 raise SidecarUnavailable("sidecar call timed out")
@@ -253,6 +293,11 @@ class SidecarClient:
         finally:
             with self._lock:
                 self._pending.pop(rid, None)
+
+    def _request(self, sock, msg_type: int, body: bytes,
+                 deadline: Deadline):
+        rid, slot = self._begin(sock, msg_type, body)
+        return self._await(sock, msg_type, rid, slot, deadline)
 
     def _call(self, msg_type: int, body: bytes,
               deadline: Deadline | None = None):
@@ -302,6 +347,15 @@ class SidecarClient:
         with self._lock:
             self._committees[(epoch, shard)] = list(pubkeys)
 
+    @staticmethod
+    def _agg_verify_result(epoch: int, shard: int, status: int,
+                           body: bytes) -> bool:
+        if status == P.STATUS_UNKNOWN_COMMITTEE:
+            raise KeyError(f"no committee for epoch {epoch} shard {shard}")
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"agg_verify failed: {status}")
+        return bool(body[0])
+
     def agg_verify(
         self, epoch: int, shard: int, payload: bytes, bitmap: bytes,
         sig: bytes, deadline: Deadline | None = None,
@@ -311,11 +365,25 @@ class SidecarClient:
             P.build_agg_verify(epoch, shard, payload, bitmap, sig),
             deadline,
         )
-        if status == P.STATUS_UNKNOWN_COMMITTEE:
-            raise KeyError(f"no committee for epoch {epoch} shard {shard}")
-        if status != P.STATUS_OK:
-            raise RuntimeError(f"agg_verify failed: {status}")
-        return bool(body[0])
+        return self._agg_verify_result(epoch, shard, status, body)
+
+    def agg_verify_begin(
+        self, epoch: int, shard: int, payload: bytes, bitmap: bytes,
+        sig: bytes, deadline: Deadline | None = None,
+    ) -> "_AsyncCall":
+        """Pipelined agg_verify: the frame goes on the wire NOW; the
+        returned handle's ``result()`` awaits and decodes the reply.
+        One attempt, no retry/backoff — the scheduler's backend worker
+        uses this to stream a whole header batch, and a failed call
+        falls back to the retrying synchronous path at the caller."""
+        dl = deadline or Deadline.after(self._call_timeout)
+        FI.fire("sidecar.call")
+        sock = self._ensure_connected(dl)
+        rid, slot = self._begin(
+            sock, P.MSG_AGG_VERIFY,
+            P.build_agg_verify(epoch, shard, payload, bitmap, sig),
+        )
+        return _AsyncCall(self, sock, rid, slot, epoch, shard, dl)
 
     def verify_batch(self, items: list,
                      deadline: Deadline | None = None) -> list:

@@ -24,17 +24,36 @@ from . import protocol as P
 
 
 class CommitteeTable:
-    """Device-resident committee: pubkey tensor + host metadata."""
+    """Device-resident committee: pubkey tensor + host metadata.  The
+    tensors build lazily — the scheduler path uses the padded
+    ``device.CommitteeTable`` (shared pinned buckets), the legacy
+    direct-XLA path its flat affine tensor, and the host fallback
+    neither (twin deployments never load jax)."""
 
     def __init__(self, pubkeys: list):
-        import jax.numpy as jnp
-
-        from ..ops import interop as I
-
         self.serialized = list(pubkeys)
-        pts = [RB.pubkey_from_bytes(pk) for pk in pubkeys]
-        self.points = pts
-        self.device_aff = jnp.asarray(I.g1_batch_affine(pts))
+        self.points = [RB.pubkey_from_bytes(pk) for pk in pubkeys]
+        self._device_aff = None
+        self._dv_table = None
+
+    @property
+    def device_aff(self):
+        if self._device_aff is None:
+            import jax.numpy as jnp
+
+            from ..ops import interop as I
+
+            self._device_aff = jnp.asarray(I.g1_batch_affine(self.points))
+        return self._device_aff
+
+    def dv_table(self):
+        """The padded device.CommitteeTable the scheduler dispatches
+        against (pad keys masked off by zero bitmap bits)."""
+        if self._dv_table is None:
+            from .. import device as DV
+
+            self._dv_table = DV.CommitteeTable(self.points)
+        return self._dv_table
 
     def __len__(self):
         return len(self.serialized)
@@ -165,6 +184,35 @@ class SidecarServer:
         from ..consensus.mask import bits_from_bytes
 
         bits = bits_from_bytes(bitmap, n)
+        from .. import device as DV
+
+        if DV.device_enabled():
+            # the sidecar deployment shares the SAME process-wide
+            # verification queue the in-process paths use: a live
+            # quorum check enters the consensus lane and coalesces
+            # with whatever else is pending — the scheduler thread
+            # (not a per-connection exec lock) serializes the device
+            try:
+                sig_pt = RB.sig_from_bytes(sig)
+            except ValueError:
+                return P.STATUS_OK, bytes([0])
+            if sig_pt is None:
+                return P.STATUS_OK, bytes([0])
+            from .. import sched
+
+            if sched.enabled():
+                ok = sched.agg_verify(
+                    table.dv_table(), bits, payload, sig_pt,
+                    lane=sched.Lane.CONSENSUS,
+                )
+            else:
+                # scheduler disarmed: per-connection threads fall back
+                # to the exec lock for device occupancy, as pre-PR 5
+                with self._exec_lock:
+                    ok = DV.agg_verify_on_device(  # graftlint: disable=GL05,GL06 reviewed: exec lock serializes device work by design
+                        table.dv_table(), bits, payload, sig_pt
+                    )
+            return P.STATUS_OK, bytes([1 if ok else 0])
         with self._exec_lock:
             # the exec lock exists to serialize device occupancy; the
             # native-lib init lock it nests is held once, briefly
@@ -220,13 +268,9 @@ class SidecarServer:
     def _on_verify_batch(self, body):
         """Batched independent verifies — ONE device program per chunk
         (the r1 version looped host bigint pairings one at a time; the
-        batched ops path is the op this service exists to serve)."""
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ..ops import bls as OB
-        from ..ops import interop as I
-
+        batched ops path is the op this service exists to serve).  On
+        the device path the batch enters the shared scheduler's sync
+        lane, coalescing with in-process traffic."""
         items = P.parse_verify_batch(body)
         results = bytearray(len(items))
         survivors = []  # (index, pk_point, h_point, sig_point)
@@ -239,6 +283,38 @@ class SidecarServer:
             if sig is None:
                 continue
             survivors.append((idx, pk, hash_to_g2(payload), sig))
+        from .. import device as DV
+
+        if DV.device_enabled():
+            from .. import sched
+
+            if sched.enabled():
+                s = sched.scheduler()
+                futures = [
+                    s.submit_single(pk, h_pt, sig, lane=sched.Lane.SYNC)
+                    for _, pk, h_pt, sig in survivors
+                ]
+                flat = []
+                for f in futures:
+                    try:
+                        flat.append(bool(f.result()))
+                    except OSError:  # deadline/shed surfaced: fail the
+                        flat.append(False)  # item, not the connection
+            else:
+                # scheduler disarmed: serialize device occupancy with
+                # the exec lock, as pre-PR 5
+                with self._exec_lock:
+                    flat = DV.verify_many_on_device(  # graftlint: disable=GL05,GL06 reviewed: exec lock serializes device work by design
+                        [s_[1] for s_ in survivors],
+                        [s_[2] for s_ in survivors],
+                        [s_[3] for s_ in survivors],
+                    )
+            for (idx, _, _, _), good in zip(survivors, flat):
+                results[idx] = 1 if good else 0
+            return (
+                P.STATUS_OK,
+                len(items).to_bytes(4, "little") + bytes(results),
+            )
         if not self._accelerated():
             for idx, pk, h_pt, sig in survivors:
                 results[idx] = (
@@ -248,6 +324,12 @@ class SidecarServer:
                 P.STATUS_OK,
                 len(items).to_bytes(4, "little") + bytes(results),
             )
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import bls as OB
+        from ..ops import interop as I
+
         widest = self._VERIFY_BUCKETS[-1]
         # _exec_lock serializes device occupancy BY DESIGN: one sidecar
         # program on the accelerator at a time, others queue here

@@ -19,6 +19,12 @@
 #      /metrics + /debug/trace over HTTP and validates the Prometheus
 #      exposition grammar and the Chrome trace-event JSON schema
 #      (names/ts/dur/pid/tid, spans properly parented).
+#   5. scheduler smoke — the continuous-batching verification
+#      scheduler tier (tests/test_sched.py), then tools/sched_smoke.py:
+#      a localnet where FBFT rounds, sync replay and an ingress flood
+#      run CONCURRENTLY through the one shared device queue; the
+#      /metrics exposition must show harmony_sched_batch_fill_ratio
+#      above its floor and ZERO consensus-lane sheds.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -47,5 +53,11 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_trace.py
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+echo "== scheduler smoke: continuous-batching tier + mixed-lane localnet =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_sched.py
+JAX_PLATFORMS=cpu python tools/sched_smoke.py
 
 echo "check.sh: OK"
