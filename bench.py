@@ -28,6 +28,54 @@ import time
 PRIMARY = "bls12_381_pairings_per_sec_per_chip"
 TARGET_PAIRINGS_S = 50_000.0
 
+# docs/PERF_MODEL.md §4: the as-written kernel's conservative projection
+# band on one v5e chip — the modeled claim every measured number is
+# ledgered against (tools/bench_ledger.py diffs measured-vs-modeled
+# across BENCH rounds; tools/bench_device.py checks the band on device).
+MODELED_BAND_PAIRINGS_S = (9_000.0, 21_000.0)
+
+
+def _m(value, unit: str, source: str = "measured", **fields) -> dict:
+    """One ledger-tagged metric: every number bench.py emits carries
+    its unit and whether it was measured on this run or derived from
+    the analytic model (ISSUE 6: no untagged metrics).  Extra fields
+    record the measurement's parameters (n_keys, mode, ...) so the
+    ledger can tell a redefinition from a regression."""
+    out = {"value": value, "unit": unit, "source": source}
+    out.update(fields)
+    return out
+
+
+def _modeled_band() -> dict:
+    lo, hi = MODELED_BAND_PAIRINGS_S
+    ref = "docs/PERF_MODEL.md §4"
+    return {
+        "modeled_pairings_per_sec_lo": _m(lo, "pairings/s", "modeled",
+                                          ref=ref),
+        "modeled_pairings_per_sec_hi": _m(hi, "pairings/s", "modeled",
+                                          ref=ref),
+    }
+
+
+def pairing_fixture(batch: int):
+    """(ps, qs) numpy affine tiles of ``batch`` G1/G2 pairs from 4
+    distinct base points — THE kernel-bench input, shared with
+    tools/bench_device.py so the bare-kernel and full-bench numbers
+    measure identical work."""
+    import numpy as np
+
+    from harmony_tpu.ops import interop as I
+    from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
+
+    base_p = [G1_GEN, g1.dbl(G1_GEN), g1.mul(G1_GEN, 5),
+              g1.mul(G1_GEN, 7)]
+    base_q = [G2_GEN, g2.dbl(G2_GEN), g2.mul(G2_GEN, 5),
+              g2.mul(G2_GEN, 7)]
+    reps = (batch + 3) // 4
+    ps = np.tile(I.g1_batch_affine(base_p), (reps, 1, 1))[:batch]
+    qs = np.tile(I.g2_batch_affine(base_q), (reps, 1, 1, 1))[:batch]
+    return ps, qs
+
 # The axon PJRT plugin reaches the TPU through a loopback relay:
 # jax.devices() goes via :8083 (stateless), sessions via :8082
 # (/root/.axon_site/axon/register/pjrt.py:187-189).  A 2 s TCP probe of
@@ -76,15 +124,17 @@ def _error_to_file(err: str, name: str):
     return reason, path
 
 
-def _honest_zero(err: str, extra=None):
+def _honest_zero(err: str, meta=None):
     _emit(
         {
             "metric": PRIMARY,
             "value": 0,
             "unit": "pairings/s",
             "vs_baseline": 0.0,
+            "source": "measured",
             "error": err[-2000:],
-            "extra": extra or {},
+            "extra": {},
+            "meta": meta or {},
         }
     )
 
@@ -148,7 +198,7 @@ def main():
     tpu_timeout = 120.0 if relay_dead else budget * 0.6
     result, err1 = _run_child(force_cpu=False, timeout_s=tpu_timeout)
     if result is not None and not result.get("error"):
-        result.setdefault("extra", {})["relay_tcp"] = relay
+        result.setdefault("meta", {})["relay_tcp"] = relay
         _emit(result)
         return 0
     # Attempt 2: forced CPU — a real measured number beats a traceback.
@@ -156,20 +206,20 @@ def main():
     if remaining < 60:
         _honest_zero(
             f"tpu attempt failed ({err1}); no time left for cpu",
-            extra={"relay_tcp": relay},
+            meta={"relay_tcp": relay},
         )
         return 0
     result2, err2 = _run_child(force_cpu=True, timeout_s=remaining)
     if result2 is not None:
-        extra = result2.setdefault("extra", {})
+        meta = result2.setdefault("meta", {})
         reason, detail = _error_to_file(err1, "tpu_attempt_error")
-        extra["tpu_attempt_error"] = reason
+        meta["tpu_attempt_error"] = reason
         if detail:
-            extra["tpu_attempt_error_file"] = detail
-        extra["relay_tcp"] = relay
+            meta["tpu_attempt_error_file"] = detail
+        meta["relay_tcp"] = relay
         _emit(result2)
         return 0
-    _honest_zero(f"tpu: {err1} || cpu: {err2}", extra={"relay_tcp": relay})
+    _honest_zero(f"tpu: {err1} || cpu: {err2}", meta={"relay_tcp": relay})
     return 0
 
 
@@ -219,7 +269,7 @@ def _child():
     from harmony_tpu.ref.curve import G1_GEN, G2_GEN, g1, g2
     from harmony_tpu.ref.hash_to_curve import hash_to_g2
 
-    extra = {"backend": backend, "configs_failed": []}
+    meta = {"backend": backend, "configs_failed": []}
     if not on_tpu:
         # XLA:CPU cannot build ANY pairing-shaped program inside the
         # budget on the 1-core fallback box (>20 min jit OR eager,
@@ -229,10 +279,10 @@ def _child():
         # otherwise.
         from harmony_tpu.ref import native as NB
 
-        extra["backend"] = (
+        meta["backend"] = (
             "cpu-native-bls381" if NB.available() else "cpu-bigint-reference"
         )
-        return _child_cpu_bigint(extra, deadline)
+        return _child_cpu_bigint(meta, deadline)
 
     # ---- shared fixtures (small host-side setup) ----------------------
     msg = b"bench-agg-verify-block-payload!!"
@@ -244,6 +294,7 @@ def _child():
     # host hash-to-G2 n_keys times (fixture setup, not the measurement)
     sigs = [g2.mul(h_pt, sk) for sk in sks]
 
+    extra = _modeled_band()
     # ---- config #2: 1000-key aggregate-verify p50 ---------------------
     # Committee table resident on device; per call: bitmap + 96B sig in,
     # bool out — the steady-state FBFT quorum check.
@@ -271,12 +322,12 @@ def _child():
             if time.monotonic() > deadline:
                 break
         if lat:
-            extra["agg_verify_p50_ms_1k_keys"] = round(
-                sorted(lat)[len(lat) // 2] * 1e3, 3
+            extra["agg_verify_p50_ms_1k_keys"] = _m(
+                round(sorted(lat)[len(lat) // 2] * 1e3, 3), "ms",
+                n_keys=n_keys,
             )
-            extra["agg_verify_n_keys"] = n_keys
     except Exception as e:  # noqa: BLE001 — report, don't crash the bench
-        extra["configs_failed"].append(f"agg_verify: {e!r:.300}")
+        meta["configs_failed"].append(f"agg_verify: {e!r:.300}")
 
     # ---- config #5: replay throughput (batched seal verify) -----------
     try:
@@ -302,21 +353,18 @@ def _child():
             assert all(res), "replay batch rejected valid seals!"
             if time.monotonic() > deadline:
                 break
-        extra["replay_headers_per_sec"] = round(width / best, 1)
-        extra["replay_committee_keys"] = 250
+        extra["replay_headers_per_sec"] = _m(
+            round(width / best, 1), "headers/s",
+            mode="device_batch_kernel", committee_keys=250, width=width,
+        )
     except Exception as e:  # noqa: BLE001
-        extra["configs_failed"].append(f"replay: {e!r:.300}")
+        meta["configs_failed"].append(f"replay: {e!r:.300}")
 
     # ---- primary: raw pairing throughput ------------------------------
     batch = int(os.environ.get("BENCH_BATCH", "256" if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "3" if on_tpu else "1"))
-    base_p = [G1_GEN, g1.dbl(G1_GEN), g1.mul(G1_GEN, 5), g1.mul(G1_GEN, 7)]
-    base_q = [G2_GEN, g2.dbl(G2_GEN), g2.mul(G2_GEN, 5), g2.mul(G2_GEN, 7)]
-    p_arr = I.g1_batch_affine(base_p)
-    q_arr = I.g2_batch_affine(base_q)
-    reps = (batch + 3) // 4
-    ps = jnp.asarray(np.tile(p_arr, (reps, 1, 1))[:batch])
-    qs = jnp.asarray(np.tile(q_arr, (reps, 1, 1, 1))[:batch])
+    ps_np, qs_np = pairing_fixture(batch)
+    ps, qs = jnp.asarray(ps_np), jnp.asarray(qs_np)
 
     fn = jax.jit(OP.pairing)
     out = fn(ps, qs)
@@ -328,12 +376,19 @@ def _child():
 
     assert e1 == RP.pairing(G1_GEN, G2_GEN), "bench result wrong!"
 
+    # HARMONY_TPU_PROFILE_DIR: the FIRST device round must leave a
+    # loadable profiler trace — no second run to re-instrument
+    from harmony_tpu import prof
+
     times = []
-    for _ in range(iters):
-        t1 = time.perf_counter()
-        fn(ps, qs).block_until_ready()
-        times.append(time.perf_counter() - t1)
+    with prof.capture():
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            fn(ps, qs).block_until_ready()
+            times.append(time.perf_counter() - t1)
     pairings_per_s = batch / min(times)
+    if prof.capture_dir():
+        meta["profile_dir"] = prof.capture_dir()
 
     # ---- Pallas-backend pairing (FP_BACKEND=pallas): the VMEM-resident
     # mont_mul (ops/fp_pallas.py) vs the scan path just measured.  The
@@ -354,13 +409,17 @@ def _child():
                 t1 = time.perf_counter()
                 fnp(ps, qs).block_until_ready()
                 ptimes.append(time.perf_counter() - t1)
-            extra["pairings_per_s_pallas"] = round(batch / min(ptimes), 1)
-            extra["pairings_per_s_scan"] = round(pairings_per_s, 1)
+            extra["pairings_per_s_pallas"] = _m(
+                round(batch / min(ptimes), 1), "pairings/s"
+            )
+            extra["pairings_per_s_scan"] = _m(
+                round(pairings_per_s, 1), "pairings/s"
+            )
             pairings_per_s = max(pairings_per_s, batch / min(ptimes))
         finally:
             FPMOD.set_backend("scan")
     except Exception as e:  # noqa: BLE001
-        extra["configs_failed"].append(f"pallas_pairing: {e!r:.300}")
+        meta["configs_failed"].append(f"pallas_pairing: {e!r:.300}")
 
     _emit(
         {
@@ -368,13 +427,141 @@ def _child():
             "value": round(pairings_per_s, 1),
             "unit": "pairings/s",
             "vs_baseline": round(pairings_per_s / TARGET_PAIRINGS_S, 4),
+            "source": "measured",
             "extra": extra,
+            "meta": meta,
         }
     )
     return 0
 
 
-def _child_cpu_bigint(extra, deadline):
+def _replay_bench_e2e(deadline):
+    """BASELINE config #5 measured END TO END (ISSUE 6): build a sealed
+    chain, then drive it through the staged-sync downloader into a
+    fresh replica — wire decode, the engine's verified-sig LRU, the
+    verification scheduler's SYNC lane, seal verification and chain
+    insert (execution included) all inside the timed window.  Replaces
+    the 1/p50-of-one-agg-verify derivation (VERDICT Weak #2): that
+    number modeled the kernel; this one measures the replay PIPELINE.
+    Twin kernels route the device-path layers onto the host crypto
+    exactly as a forced-device localnet does.
+
+    The committee signs each block via the aggregate secret (Σ sk_i —
+    the aggregate of all N signatures equals (Σ sk_i)·H(payload)), so
+    fixture construction costs one G2 mul per block, not N."""
+    import time as _t
+
+    os.environ["HARMONY_KERNEL_TWIN"] = "1"
+    from harmony_tpu import device as DV
+    from harmony_tpu import sched as SC
+    from harmony_tpu.chain.engine import Engine, EpochContext
+    from harmony_tpu.consensus.mask import Mask
+    from harmony_tpu.consensus.signature import construct_commit_payload
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.types import Block
+    from harmony_tpu.node.worker import Worker
+    from harmony_tpu.ref import bls as RB
+    from harmony_tpu.ref import native as NB
+    from harmony_tpu.ref.curve import R_ORDER, g2
+    from harmony_tpu.ref.hash_to_curve import hash_to_g2
+    from harmony_tpu.sync.staged import Downloader
+
+    n_headers = int(os.environ.get("BENCH_REPLAY_HEADERS", "2048"))
+    committee_n = int(os.environ.get("BENCH_REPLAY_COMMITTEE", "64"))
+    DV.use_device(True)
+    SC.reset()
+    try:
+        genesis, _, bls_keys = dev_genesis(n_accounts=2,
+                                           n_keys=committee_n)
+        chain_id = genesis.config.chain_id
+        sk_sum = sum(k.scalar for k in bls_keys) % R_ORDER
+        g2mul = NB.g2_mul if NB.available() else g2.mul
+        mask = Mask([k.pub.point for k in bls_keys])
+        for i in range(committee_n):
+            mask.set_bit(i, True)
+        bitmap = mask.mask_bytes()
+
+        # -- fixture: a sealed source chain, serialized as the sync
+        # wire would carry it (build phase, untimed) ------------------
+        src = Blockchain(MemKV(), genesis, blocks_per_epoch=1 << 30)
+        worker = Worker(src)
+        blobs, hashes = [], []
+        prev = None
+        # the replay pass costs about as much as the build (same
+        # execution work + the seal checks); keep a symmetric reserve
+        build_stop = _t.monotonic() + (deadline - _t.monotonic()) / 2.5
+        for i in range(n_headers):
+            block = worker.propose_block(view_id=i + 1, timestamp=i + 1)
+            if prev is not None:
+                block.header.last_commit_sig = prev[:96]
+                block.header.last_commit_bitmap = prev[96:]
+            payload = construct_commit_payload(
+                block.header.hash(), block.header.block_num,
+                block.header.view_id, True,
+            )
+            proof = RB.sig_to_bytes(
+                g2mul(hash_to_g2(payload), sk_sum)
+            ) + bitmap
+            src.insert_chain([block], commit_sigs=[proof],
+                             verify_seals=False)
+            blobs.append((rawdb.encode_header(block.header),
+                          rawdb.encode_body(block, chain_id), proof))
+            hashes.append(block.hash())
+            prev = proof
+            if _t.monotonic() > build_stop:
+                break
+
+        class _Feed:
+            """SyncClient twin serving the serialized chain — the
+            decode cost the real sync stream pays, minus the socket."""
+
+            def get_head(self, deadline=None):
+                return len(blobs), hashes[-1]
+
+            def get_block_hashes(self, start, count, deadline=None):
+                return hashes[start - 1:start - 1 + count]
+
+            def get_blocks_by_number(self, start, count, deadline=None):
+                out = []
+                for hdr, body, sig in blobs[start - 1:start - 1 + count]:
+                    header = rawdb.decode_header(hdr)
+                    txs, stxs, cxs, order = rawdb.decode_body(body)
+                    out.append(
+                        (Block(header, txs, stxs, cxs, order), sig)
+                    )
+                return out
+
+        # -- the timed replay -----------------------------------------
+        ctx = EpochContext(list(genesis.committee))
+        replica = Blockchain(
+            MemKV(), genesis,
+            engine=Engine(lambda s, e: ctx, device=True),
+            blocks_per_epoch=1 << 30,
+        )
+        t0 = _t.perf_counter()
+        res = Downloader(replica, [_Feed()], verify_seals=True).sync_once()
+        dt = _t.perf_counter() - t0
+        if res.errors or res.inserted != len(blobs):
+            raise RuntimeError(
+                f"replay incomplete: {res.inserted}/{len(blobs)} "
+                f"{res.errors[:2]}"
+            )
+        return _m(
+            round(res.inserted / dt, 2), "headers/s",
+            mode="staged_sync_e2e", headers=res.inserted,
+            committee_keys=committee_n,
+            path="decode+lru+sched+verify+insert",
+        )
+    finally:
+        SC.reset()
+        DV.use_device(None)
+        os.environ.pop("HARMONY_KERNEL_TWIN", None)
+
+
+def _child_cpu_bigint(meta, deadline):
     """Honest fallback numbers from the host crypto path: the driver's
     TPU tunnel has been dead in every prior round; a labeled host
     measurement beats a traceback and gives optimization work a floor
@@ -390,6 +577,7 @@ def _child_cpu_bigint(extra, deadline):
     from harmony_tpu.ref.hash_to_curve import hash_to_g2
 
     native = NB.available()
+    extra = _modeled_band()
 
     msg = b"bench-agg-verify-block-payload!!"
     h_pt = hash_to_g2(msg)
@@ -416,14 +604,20 @@ def _child_cpu_bigint(extra, deadline):
                 if _t.monotonic() > deadline:
                     break
             p50 = sorted(lat)[len(lat) // 2]
-            extra[label] = round(p50 * 1e3, 1)
-            extra["agg_verify_n_keys"] = n_keys
-            # replay throughput floor: one seal check per header
-            extra["replay_headers_per_sec_host"] = round(1.0 / p50, 2)
+            extra[label] = _m(round(p50 * 1e3, 1), "ms", n_keys=n_keys)
         except Exception as e:  # noqa: BLE001
-            extra["configs_failed"].append(
+            meta["configs_failed"].append(
                 f"agg_verify_host_{n_keys}: {e!r:.300}"
             )
+
+    # the TRUE replay number (decode + LRU + scheduler + verify +
+    # insert through sync/staged.py) — the 1/p50 derivation this key
+    # used to carry is retired; the ledger reads the mode change as a
+    # redefinition, not a regression
+    try:
+        extra["replay_headers_per_sec_host"] = _replay_bench_e2e(deadline)
+    except Exception as e:  # noqa: BLE001
+        meta["configs_failed"].append(f"replay_e2e: {e!r:.300}")
 
     # config #2 at the 1000-key target, measured THROUGH the
     # verification scheduler under concurrent replay load (ISSUE 5):
@@ -480,27 +674,27 @@ def _child_cpu_bigint(extra, deadline):
                     break
             stop.set()
             loader.join(timeout=30)
-            extra["agg_verify_p50_ms_host_1k"] = round(
-                sorted(lat)[len(lat) // 2] * 1e3, 1
-            )
-            # the key predates ISSUE 5 but the MEASUREMENT changed in
+            # mode stamped on the metric (the measurement changed in
             # r06: through the scheduler, twin kernels, under replay
-            # load — mark it so trend diffs read a redefinition, not a
-            # host-crypto regression
-            extra["agg_verify_1k_mode"] = "sched_mixed_lane_twin"
+            # load — trend diffs must read a redefinition, not a
+            # host-crypto regression)
+            extra["agg_verify_p50_ms_host_1k"] = _m(
+                round(sorted(lat)[len(lat) // 2] * 1e3, 1), "ms",
+                n_keys=n_max, mode="sched_mixed_lane_twin",
+            )
             d_items = _FILL["items"] - items0
             d_slots = _FILL["slots"] - slots0
             if d_slots:
-                extra["sched_batch_fill_ratio"] = round(
-                    d_items / d_slots, 3
+                extra["sched_batch_fill_ratio"] = _m(
+                    round(d_items / d_slots, 3), "ratio"
                 )
-            extra["sched_items_dispatched"] = d_items
+            extra["sched_items_dispatched"] = _m(d_items, "items")
         finally:
             SC.reset()
             DV.use_device(None)
             os.environ.pop("HARMONY_KERNEL_TWIN", None)
     except Exception as e:  # noqa: BLE001
-        extra["configs_failed"].append(f"agg_verify_sched_1k: {e!r:.300}")
+        meta["configs_failed"].append(f"agg_verify_sched_1k: {e!r:.300}")
 
     # primary: raw host pairing throughput (full pairing incl. final exp)
     if native:
@@ -524,8 +718,9 @@ def _child_cpu_bigint(extra, deadline):
         while _t.perf_counter() - t0 < 2.0 and _t.monotonic() < deadline:
             NB.multi_pairing(pairs)
             reps += 1
-        extra["pairing_product_pairs_per_sec"] = round(
-            reps * len(pairs) / (_t.perf_counter() - t0), 1
+        extra["pairing_product_pairs_per_sec"] = _m(
+            round(reps * len(pairs) / (_t.perf_counter() - t0), 1),
+            "pairs/s",
         )
     else:
         n = 6
@@ -542,7 +737,9 @@ def _child_cpu_bigint(extra, deadline):
             "value": round(rate, 2),
             "unit": "pairings/s",
             "vs_baseline": round(rate / TARGET_PAIRINGS_S, 6),
+            "source": "measured",
             "extra": extra,
+            "meta": meta,
         }
     )
     return 0

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from .. import prof
 from ..consensus.mask import Mask, bits_from_bytes
 from ..consensus.quorum import Decider, Policy
 from ..consensus.signature import construct_commit_payload
@@ -293,7 +294,8 @@ class Engine:
             if self.backend is not None:
                 backend_calls.append((idx, header, ctx, payload))
                 continue
-            h_pt = hash_to_g2(payload)
+            with prof.stage("hash_to_g2"):
+                h_pt = hash_to_g2(payload)
             if self.device:
                 groups.setdefault(id(ctx), (ctx, []))[1].append(
                     (idx, mask.bit_vector(), h_pt, sig)

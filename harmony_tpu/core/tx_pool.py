@@ -333,10 +333,14 @@ class TxPool:
             return self._stats_unlocked()
 
     def add(self, tx, is_staking: bool = False,
-            local: bool = False) -> bytes:
+            local: bool = False, sender: bytes | None = None) -> bytes:
         # recover the signature BEFORE taking the lock: it is the
-        # dominant cost of admission and needs no pool state
-        sender = self._recover_sender(tx)
+        # dominant cost of admission and needs no pool state.  Callers
+        # that already recovered the sender (gossip pre-filter, load
+        # harnesses pacing submission independently of the pure-Python
+        # secp256k1 stand-in) pass it in and skip the repeat.
+        if sender is None:
+            sender = self._recover_sender(tx)
         if is_staking:
             # BLS key-registration proofs verify OUTSIDE the lock too,
             # on the scheduler's ingress lane (PR 2 hoisted the ECDSA
@@ -358,10 +362,22 @@ class TxPool:
             return sender
 
     def _record_add(self, tx, is_staking: bool):
+        # the tx OBJECT rides the ring; its hash is computed lazily in
+        # adds_since — the pure-Python keccak was 97% of admission cost
+        # (measured r06), paid per ADD for a feed only websocket
+        # subscribers read.  Third slot: the hash memo the first
+        # reader fills (dropping the tx ref), so N subscribers still
+        # cost one keccak per tx and read entries pin no bodies.
+        # Large-calldata txs hash eagerly instead: pinning up to 4096
+        # big bodies after they leave the pool would dwarf the keccak
+        # this path avoids (and their keccak is size-bound anyway).
         self._add_seq += 1
-        self._recent_adds.append(
-            (self._add_seq, tx.hash(self.chain_id))
-        )
+        if len(getattr(tx, "data", b"") or b"") > 1024:
+            self._recent_adds.append(
+                [self._add_seq, None, tx.hash(self.chain_id)]
+            )
+        else:
+            self._recent_adds.append([self._add_seq, tx, None])
 
     @property
     def add_seq(self) -> int:
@@ -370,11 +386,24 @@ class TxPool:
 
     def adds_since(self, seq: int):
         """(latest_seq, [tx hashes admitted after ``seq``]) — the push
-        feed for newPendingTransactions subscribers."""
+        feed for newPendingTransactions subscribers.  Hashing happens
+        HERE (outside the lock, on the subscriber's thread), not at
+        admission: the keccak per tx belongs to the reader, never to
+        the hot add path.  The memo slot makes it once per TX, not
+        once per subscriber (the write is a GIL-atomic idempotent
+        list-item store; a racing reader at worst recomputes)."""
         with self._lock:
-            return self._add_seq, [
-                h for s, h in self._recent_adds if s > seq
-            ]
+            latest = self._add_seq
+            tail = [e for e in self._recent_adds if e[0] > seq]
+        hashes = []
+        for entry in tail:
+            h = entry[2]
+            if h is None:
+                h = entry[1].hash(self.chain_id)
+                entry[2] = h
+                entry[1] = None  # memoized: stop pinning the body
+            hashes.append(h)
+        return latest, hashes
 
     # -- local tx journal (reference: core/tx_journal.go — locally
     # submitted txs survive a node restart; remote gossip does not) ---------

@@ -20,9 +20,9 @@ Berlin re-pricing (reset 2900, SLOAD-like 100) when 2929 is on.
 Refunds capped at gas_used // 2 (Istanbul rule, as the reference's
 chain config uses pre-London gas policy).
 
-Precompiles 0x1-0x5, 0x9-shape: ecrecover, sha256, ripemd160,
-identity, modexp (bn256 pairing precompiles return failure — no BN254
-lattice here; the BLS12-381 ops own the pairing budget).  Address 252
+Precompiles 0x1-0x9: ecrecover, sha256, ripemd160, identity, modexp,
+bn256 add/mul/pairing (crypto_bn256.py — the from-scratch alt_bn128
+bigint twin) and blake2f.  Address 252
 is the Harmony staking precompile (write-capable: Delegate/Undelegate/
 CollectRewards from contract code, beacon shard only — reference:
 staking/precompile.go, core/vm/contracts_write.go).

@@ -20,6 +20,7 @@ import threading
 import time
 
 from . import faultinject as FI
+from . import prof
 from . import trace
 from .log import get_logger
 from .metrics import Gauge, Histogram, LockedCounters
@@ -309,6 +310,7 @@ def _get_verify_fn():
                     return call(pk, hh, sg)
             return jitted(pk, hh, sg)
 
+        dispatch._jitted = jitted  # prof cost-analysis target
         _verify_fn = dispatch
     return _verify_fn
 
@@ -335,6 +337,7 @@ def _get_agg_verify_fn():
                     return call(tbl, bits, h, sig)
             return jitted(tbl, bits, h, sig)
 
+        dispatch._jitted = jitted  # prof cost-analysis target
         _agg_verify_fn = dispatch
     return _agg_verify_fn
 
@@ -361,6 +364,7 @@ def _get_agg_verify_batch_fn():
                     return call(tbl, bm, hh, sg)
             return jitted(tbl, bm, hh, sg)
 
+        dispatch._jitted = jitted  # prof cost-analysis target
         _agg_verify_batch_fn = dispatch
     return _agg_verify_batch_fn
 
@@ -406,9 +410,9 @@ def agg_verify_on_device(table: CommitteeTable, bits, payload: bytes,
     reference host path."""
     from .ref.hash_to_curve import hash_to_g2
 
-    return agg_verify_hashed_on_device(
-        table, bits, hash_to_g2(payload), sig_point
-    )
+    with prof.stage("hash_to_g2"):
+        h_point = hash_to_g2(payload)
+    return agg_verify_hashed_on_device(table, bits, h_point, sig_point)
 
 
 def agg_verify_hashed_on_device(table: CommitteeTable, bits, h_point,
@@ -442,13 +446,17 @@ def agg_verify_hashed_on_device(table: CommitteeTable, bits, h_point,
         program = f"agg_verify_b{table.size}"
         first = _program_first_use(program) if fused else False
         t0 = time.monotonic()
-        ok = fn(
+        call_args = (
             table.device_array(), asarray(bm), asarray(hh), asarray(sg)
         )
+        ok = fn(*call_args)
         res = np.asarray(ok)
+        elapsed = time.monotonic() - t0
         if first:
-            JIT_COMPILE_SECONDS.set(time.monotonic() - t0,
-                                    program=program)
+            JIT_COMPILE_SECONDS.set(elapsed, program=program)
+            prof.on_first_dispatch(program, fn, call_args, elapsed)
+        else:
+            prof.observe_execute(program, elapsed)
         TRANSFER.inc("d2h", res.nbytes)
         trace.annotate(
             program=program, bucket=table.size,
@@ -530,16 +538,25 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
             program = f"agg_verify_batch_b{table.size}x{padded}"
             first = _program_first_use(program) if fused else False
             t0 = time.monotonic()
-            ok = fn(tbl, asarray(bm), asarray(hh), asarray(sg))
+            call_args = (tbl, asarray(bm), asarray(hh), asarray(sg))
+            ok = fn(*call_args)
             if first:
                 compiles.append((program, time.monotonic() - t0))
+                prof.on_first_dispatch(program, fn, call_args,
+                                       time.monotonic() - t0)
             COUNTERS.inc("batch_verify")
-            pending.append((ok, n))
+            # a compiling chunk's drain time is compile, not execute —
+            # it is recorded by on_first_dispatch, not the exec histo
+            pending.append((ok, n, program, None if first else t0))
         TRANSFER.inc("h2d", h2d)
         d2h = 0
-        for ok, n in pending:
+        for ok, n, program, t_issue in pending:
             # all programs are in flight; this loop only drains results
             flat = np.asarray(ok)  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
+            # issue->drain latency per chunk: what "execute" means for
+            # a streamed dispatch (includes queueing behind siblings)
+            if t_issue is not None:
+                prof.observe_execute(program, time.monotonic() - t_issue)
             d2h += flat.nbytes
             results.extend(bool(x) for x in flat[:n])
         TRANSFER.inc("d2h", d2h)
@@ -571,7 +588,8 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
     """
     from .ref.hash_to_curve import hash_to_g2
 
-    h = hash_to_g2(payload)
+    with prof.stage("hash_to_g2"):
+        h = hash_to_g2(payload)
     COUNTERS.inc("verify")
 
     def dispatch() -> bool:
@@ -603,11 +621,15 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
         first = _program_first_use(program) if fused else False
         t0 = time.monotonic()
         fn = _get_verify_fn() if fused else OB.verify
-        ok = fn(asarray(pk), asarray(hh), asarray(sg))
+        call_args = (asarray(pk), asarray(hh), asarray(sg))
+        ok = fn(*call_args)
         res = np.asarray(ok)
+        elapsed = time.monotonic() - t0
         if first:
-            JIT_COMPILE_SECONDS.set(time.monotonic() - t0,
-                                    program=program)
+            JIT_COMPILE_SECONDS.set(elapsed, program=program)
+            prof.on_first_dispatch(program, fn, call_args, elapsed)
+        else:
+            prof.observe_execute(program, elapsed)
         TRANSFER.inc("d2h", res.nbytes)
         trace.annotate(
             program=program, width=width,
@@ -686,15 +708,21 @@ def verify_many_on_device(pk_points, h_points, sig_points) -> list:
             program = f"verify_w{padded}"
             first = _program_first_use(program) if fused else False
             t0 = time.monotonic()
-            ok = fn(asarray(pk), asarray(hh), asarray(sg))
+            call_args = (asarray(pk), asarray(hh), asarray(sg))
+            ok = fn(*call_args)
             if first:
                 compiles.append((program, time.monotonic() - t0))
-            pending.append((ok, n))
+                prof.on_first_dispatch(program, fn, call_args,
+                                       time.monotonic() - t0)
+            pending.append((ok, n, program, None if first else t0))
         TRANSFER.inc("h2d", h2d)
         d2h = 0
-        for ok, n in pending:
+        for ok, n, program, t_issue in pending:
             # all programs are in flight; this loop only drains results
             flat = np.asarray(ok)  # graftlint: disable=GL07 reviewed: every chunk dispatched above, this is the drain
+            # issue->drain latency per chunk (see batch path above)
+            if t_issue is not None:
+                prof.observe_execute(program, time.monotonic() - t_issue)
             d2h += flat.nbytes
             results.extend(bool(x) for x in flat[:n])
         TRANSFER.inc("d2h", d2h)

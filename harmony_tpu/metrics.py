@@ -130,6 +130,43 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (0 <= q <= 1) from the bucket counts —
+        Prometheus histogram_quantile semantics: find the bucket the
+        rank lands in, interpolate linearly inside it.  The +Inf bucket
+        clamps to the last finite bound (the standard overestimate-free
+        convention).  None while the histogram is empty.
+
+        This is the helper that lets loadgen/bench report p99 without
+        hand-parsing bucket counts (ISSUE 6 satellite)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._total
+        if total == 0:
+            return None
+        q = min(1.0, max(0.0, q))
+        rank = q * total
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            prev_cum, cum = cum, cum + counts[i]
+            if cum >= rank and counts[i]:
+                lo = self.buckets[i - 1] if i else 0.0
+                frac = (rank - prev_cum) / counts[i]
+                return lo + (b - lo) * frac
+        return float(self.buckets[-1])
+
+    def summary(self, quantiles=(0.5, 0.99)) -> dict:
+        """{count, sum_s, p50_s, p99_s, ...} — the report-ready digest
+        (keys follow ``p{percent}_s`` for each requested quantile)."""
+        with self._lock:
+            total, sum_ = self._total, self._sum
+        out = {"count": total, "sum_s": round(sum_, 6)}
+        for q in quantiles:
+            v = self.quantile(q)
+            key = f"p{q * 100:g}_s"
+            out[key] = round(v, 6) if v is not None else None
+        return out
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
@@ -184,6 +221,9 @@ class Registry:
         lines.append(self._device_counters())
         lines.append(self._resilience_counters())
         lines.append(self._sched_counters())
+        prof = self._prof_counters()
+        if prof:
+            lines.append(prof)
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -245,6 +285,15 @@ class Registry:
         from . import sched
 
         return sched.expose_metrics()
+
+    @staticmethod
+    def _prof_counters() -> str:
+        """Kernel-stage profiler families (stage/execute/compile
+        histograms, per-program XLA cost-analysis gauges) — empty
+        until the profiler has recorded anything (ISSUE 6)."""
+        from . import prof
+
+        return prof.expose()
 
     @staticmethod
     def _resilience_counters() -> str:

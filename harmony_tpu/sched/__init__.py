@@ -115,10 +115,13 @@ def verify_single(pk_point, payload: bytes, sig_point, *,
 
     if not enabled():
         return DV.verify_on_device(pk_point, payload, sig_point)
+    from .. import prof
     from ..ref.hash_to_curve import hash_to_g2
 
+    with prof.stage("hash_to_g2"):
+        h_point = hash_to_g2(payload)
     return _await(scheduler().submit_single(
-        pk_point, hash_to_g2(payload), sig_point,
+        pk_point, h_point, sig_point,
         lane=lane, deadline=deadline,
     ), deadline)
 
@@ -131,10 +134,13 @@ def agg_verify(table, bits, payload: bytes, sig_point, *,
 
     if not enabled():
         return DV.agg_verify_on_device(table, bits, payload, sig_point)
+    from .. import prof
     from ..ref.hash_to_curve import hash_to_g2
 
+    with prof.stage("hash_to_g2"):
+        h_point = hash_to_g2(payload)
     return _await(scheduler().submit_agg(
-        table, bits, hash_to_g2(payload), sig_point,
+        table, bits, h_point, sig_point,
         lane=lane, deadline=deadline,
     ), deadline)
 

@@ -1,0 +1,189 @@
+"""tools/bench_ledger.py: measured-vs-modeled round comparison flags."""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+from bench_ledger import (  # noqa: E402
+    diff,
+    direction,
+    load_rounds,
+    main,
+    normalize,
+)
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+
+def _tagged(v, unit="x/s", source="measured", **kw):
+    return dict({"value": v, "unit": unit, "source": source}, **kw)
+
+
+# -- normalize ---------------------------------------------------------------
+
+
+def test_normalize_handles_tagged_legacy_and_missing():
+    tagged = normalize({
+        "metric": "p", "value": 1.5, "source": "measured",
+        "extra": {"a_per_sec": _tagged(10.0),
+                  "note": "not-a-metric",
+                  "legacy_ms": 7.0},
+    })
+    assert tagged["p"]["value"] == 1.5
+    assert tagged["a_per_sec"]["source"] == "measured"
+    assert tagged["legacy_ms"]["source"] is None
+    assert "note" not in tagged
+    assert normalize(None) == {}
+
+
+def test_normalize_attaches_legacy_mode_sibling():
+    out = normalize({"extra": {
+        "agg_verify_p50_ms_host_1k": 10.9,
+        "agg_verify_1k_mode": "sched_mixed_lane_twin",
+    }})
+    assert out["agg_verify_p50_ms_host_1k"]["mode"] == (
+        "sched_mixed_lane_twin"
+    )
+
+
+def test_ambiguous_legacy_mode_sibling_attaches_to_none():
+    """Two metrics matching the stem: stamping either could launder a
+    real regression into 'redefined' — so neither gets the mode and
+    both stay comparable."""
+    out = normalize({"extra": {
+        "agg_verify_p50_ms_host_1k": 10.9,
+        "agg_verify_p50_ms_1k_keys": 0.4,
+        "agg_verify_1k_mode": "sched_mixed_lane_twin",
+    }})
+    assert out["agg_verify_p50_ms_host_1k"]["mode"] is None
+    assert out["agg_verify_p50_ms_1k_keys"]["mode"] is None
+
+
+# -- direction ---------------------------------------------------------------
+
+
+def test_direction_map():
+    assert direction("replay_headers_per_sec_host") == 1
+    assert direction("agg_verify_p50_ms_host") == -1
+    assert direction("round_p99_s_latency") == -1
+    assert direction("sched_batch_fill_ratio") == 1
+    assert direction("agg_verify_n_keys") == 0  # parameter, never flagged
+    assert direction("some_mystery_number") == 0
+
+
+# -- diff / flags ------------------------------------------------------------
+
+
+def _pair(ma, mb, threshold=0.30):
+    return diff([(5, "a", ma), (6, "b", mb)], threshold)
+
+
+def test_throughput_drop_flags_regression():
+    flags = _pair({"x_per_sec": _tagged(100.0)},
+                  {"x_per_sec": _tagged(50.0)})
+    assert [f["kind"] for f in flags] == ["regression"]
+    assert flags[0]["change_pct"] == -50.0
+
+
+def test_latency_drop_is_an_improvement():
+    flags = _pair({"x_p50_ms": _tagged(100.0, "ms")},
+                  {"x_p50_ms": _tagged(10.0, "ms")})
+    assert [f["kind"] for f in flags] == ["improvement"]
+
+
+def test_latency_rise_flags_regression():
+    flags = _pair({"x_p50_ms": _tagged(10.0, "ms")},
+                  {"x_p50_ms": _tagged(100.0, "ms")})
+    assert [f["kind"] for f in flags] == ["regression"]
+
+
+def test_within_threshold_is_silent():
+    flags = _pair({"x_per_sec": _tagged(100.0)},
+                  {"x_per_sec": _tagged(80.0)})  # -20% < 30%
+    assert flags == []
+
+
+def test_mode_change_is_redefinition_not_regression():
+    """r06's replay redefinition: the measured number fell 8x because
+    the MEASUREMENT changed (1/p50 kernel derivation -> end-to-end
+    pipeline) — the ledger must say so instead of crying regression."""
+    flags = _pair(
+        {"replay_headers_per_sec_host": {
+            "value": 200.35, "unit": None, "source": None}},
+        {"replay_headers_per_sec_host": _tagged(
+            23.9, "headers/s", mode="staged_sync_e2e")},
+    )
+    assert [f["kind"] for f in flags] == ["redefined"]
+
+
+def test_param_change_is_redefinition():
+    """Same source+mode but a different measurement parameter (e.g.
+    BENCH_REPLAY_COMMITTEE) is a redefinition, not a speedup."""
+    flags = _pair(
+        {"replay_per_sec": dict(_tagged(24.0), mode="e2e",
+                                params={"committee_keys": 64})},
+        {"replay_per_sec": dict(_tagged(90.0), mode="e2e",
+                                params={"committee_keys": 16})},
+    )
+    assert [f["kind"] for f in flags] == ["redefined"]
+
+
+def test_source_backfill_alone_stays_comparable():
+    """The r05->r06 untagged->tagged migration must NOT blind the
+    gate: source None -> 'measured' with unchanged mode/params is
+    still a comparison, so a genuine r06 regression flags."""
+    flags = _pair(
+        {"agg_p50_ms": {"value": 10.0, "source": None, "mode": None,
+                        "params": {}}},
+        {"agg_p50_ms": dict(_tagged(100.0, "ms"))},
+    )
+    assert [f["kind"] for f in flags] == ["regression"]
+
+
+def test_unknown_direction_never_flags():
+    flags = _pair({"mystery": _tagged(100.0)},
+                  {"mystery": _tagged(1.0)})
+    assert flags == []
+
+
+def test_new_and_dropped_are_informational():
+    flags = _pair({"old_per_sec": _tagged(1.0)},
+                  {"new_per_sec": _tagged(1.0)})
+    kinds = sorted(f["kind"] for f in flags)
+    assert kinds == ["dropped", "new"]
+
+
+# -- the committed history + CLI gate ----------------------------------------
+
+
+def test_committed_bench_rounds_pass_the_check(capsys):
+    """check.sh stage 6 runs --check over the committed BENCH files;
+    this pins that the committed history stays regression-free under
+    the default threshold."""
+    rc = main(["--check"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["ok"] is True
+
+
+def test_check_exits_nonzero_on_regression(tmp_path, capsys):
+    a = tmp_path / "BENCH_r90.json"
+    b = tmp_path / "BENCH_r91.json"
+    a.write_text(json.dumps({"n": 90, "parsed": {
+        "metric": "x_per_sec", "value": 100.0, "source": "measured"}}))
+    b.write_text(json.dumps({"n": 91, "parsed": {
+        "metric": "x_per_sec", "value": 10.0, "source": "measured"}}))
+    rc = main([str(a), str(b), "--check"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["ok"] is False
+    assert any(f["kind"] == "regression" for f in report["flags"])
+
+
+def test_load_rounds_orders_by_round_number():
+    paths = sorted(str(p) for p in ROOT.glob("BENCH_r*.json"))
+    rounds = load_rounds(paths)
+    assert [r[0] for r in rounds] == sorted(r[0] for r in rounds)
+    assert len(rounds) >= 5

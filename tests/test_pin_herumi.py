@@ -1,5 +1,7 @@
 """tools/pin_herumi.py: convention pinning from signature vectors."""
 
+import json
+import pathlib
 import sys
 
 import pytest
@@ -51,3 +53,41 @@ def test_default_convention_is_mcl_best_guess():
     """The shipped default is the documented mcl-source best guess;
     flipping it is an env/config action, never a code edit."""
     assert HM.MAP_CONVENTION == {"root": "algorithmic", "cofactor": "h2"}
+
+
+# -- the committed self-parity pin (VERDICT Missing #5) ----------------------
+
+_PIN_FILE = pathlib.Path(__file__).parent / "vectors" / (
+    "herumi_signhash_pin.json"
+)
+
+
+def _committed_vectors():
+    with open(_PIN_FILE) as f:
+        return json.load(f)
+
+
+def test_committed_vectors_pin_the_default_convention():
+    """The committed vector file pins SignHash to exactly the shipped
+    default — the pin tools/pin_herumi.py would emit for it."""
+    vecs = [
+        (bytes.fromhex(v["pk"]), bytes.fromhex(v["msg"]),
+         bytes.fromhex(v["sig"]))
+        for v in _committed_vectors()
+    ]
+    res = pin_from_vectors(vecs)
+    assert res["pin"] == {"root": "algorithmic", "cofactor": "h2"}, (
+        f"committed vectors no longer pin uniquely: {res['matches']}"
+    )
+
+
+def test_committed_vectors_reproduce_byte_for_byte():
+    """Regenerating each committed vector from its sk must reproduce
+    the committed bytes EXACTLY: any drift in the sqrt-root choice,
+    cofactor clearing, or the G1/G2 serialization (the conventions a
+    device round would silently inherit) breaks here first."""
+    from pin_herumi import emit_vectors
+
+    committed = _committed_vectors()
+    regenerated = emit_vectors(len(committed))
+    assert regenerated == committed

@@ -1,0 +1,262 @@
+#!/usr/bin/env python3
+"""Bench ledger: diff BENCH_r*.json across rounds, flag regressions.
+
+Every round the driver records one ``BENCH_rNN.json`` (bench.py's JSON
+line under ``parsed``); until now nobody compared them — a kernel PR
+that halved replay throughput would have shipped silently (ISSUE 6).
+This tool normalizes every round's metrics (tagged r06+ schema and the
+legacy untagged extras alike), diffs consecutive rounds direction-aware
+(throughput up = good, latency down = good), and emits machine-readable
+flags:
+
+    regression   — a comparable metric moved WORSE than --threshold
+    improvement  — moved better than the threshold (informational)
+    redefined    — the metric's measurement changed between rounds
+                   (source tag or mode stamp differs) — NOT comparable,
+                   never a regression (e.g. r06 redefining
+                   replay_headers_per_sec_host from a 1/p50 derivation
+                   to the measured staged-sync pipeline)
+    new/dropped  — metric (dis)appeared (informational)
+
+Exit codes: ``--check`` exits 1 iff any regression flag survives; plain
+runs always exit 0 (report mode).  Output is one JSON document.
+
+Usage:
+    python tools/bench_ledger.py                  # all BENCH_r*.json
+    python tools/bench_ledger.py --check          # CI gate (check.sh)
+    python tools/bench_ledger.py A.json B.json    # explicit rounds
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Direction of goodness by metric-name shape.  Metrics matching no
+# pattern are diffed but never flagged (unknown direction).
+_UP_PATTERNS = ("_per_sec", "_per_s", "pairings_per_s", "pairs_per_sec",
+                "fill_ratio", "tx_per_s")
+_DOWN_PATTERNS = ("_ms", "_seconds", "_s_", "p50", "p99", "latency")
+
+# Bookkeeping values that are parameters, not performance metrics.
+_SKIP = ("_n_keys", "_mode", "items_dispatched", "vs_baseline")
+
+# Tagged fields that are run OUTCOMES or doc pointers, not measurement
+# configuration — excluded from the definition params: `headers` is
+# how many blocks the time-budgeted fixture build managed this round,
+# and letting it veto comparability would launder a replay regression
+# (slower build -> fewer headers -> "redefined") past --check.
+_NON_DEFINITION_FIELDS = ("value", "unit", "source", "mode", "ref",
+                          "headers", "window_s", "rounds")
+
+
+def direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    low = name.lower()
+    if any(p in low for p in _SKIP):
+        return 0
+    if any(p in low for p in _UP_PATTERNS):
+        return 1
+    if any(p in low for p in _DOWN_PATTERNS):
+        return -1
+    return 0
+
+
+def _attach_legacy_modes(out: dict, extra: dict) -> None:
+    """Legacy ``<stem>_mode`` string siblings (pre-r06 convention:
+    ``agg_verify_1k_mode`` pairs with ``agg_verify_p50_ms_host_1k``)
+    attach to the UNIQUE metric containing every stem token.  An
+    ambiguous stem (several candidates) attaches to NONE: mis-stamping
+    a mode would launder a real regression into a 'redefined' flag,
+    which is exactly what the --check gate exists to catch."""
+    for k, v in extra.items():
+        if not (k.endswith("_mode") and isinstance(v, str)):
+            continue
+        tokens = [t for t in k[: -len("_mode")].split("_") if t]
+        matches = [
+            name for name in out
+            if all(t in name.split("_") for t in tokens)
+        ]
+        if len(matches) == 1 and out[matches[0]]["mode"] is None:
+            out[matches[0]]["mode"] = v
+
+
+def normalize(parsed) -> dict:
+    """One round's record -> {metric: {value, source, mode, unit}}.
+
+    Accepts the r06+ tagged schema ({"value", "unit", "source", ...}
+    dicts in ``extra``), the legacy flat-number extras, and None
+    (rounds whose bench never emitted — r01/r02)."""
+    out: dict = {}
+    if not isinstance(parsed, dict):
+        return out
+    if "metric" in parsed and isinstance(parsed.get("value"), (int, float)):
+        out[parsed["metric"]] = {
+            "value": float(parsed["value"]),
+            "source": parsed.get("source"),
+            "mode": None,
+            "unit": parsed.get("unit"),
+        }
+    extra = parsed.get("extra") or {}
+    for name, entry in extra.items():
+        if isinstance(entry, dict) and isinstance(
+            entry.get("value"), (int, float)
+        ):
+            out[name] = {
+                "value": float(entry["value"]),
+                "source": entry.get("source"),
+                "mode": entry.get("mode") if isinstance(
+                    entry.get("mode"), str
+                ) else None,
+                "unit": entry.get("unit"),
+                # the measurement's parameters (n_keys, width,
+                # committee_keys, ...) — part of its DEFINITION for
+                # the comparability check below; run outcomes and doc
+                # pointers are not (_NON_DEFINITION_FIELDS)
+                "params": {
+                    k: v for k, v in entry.items()
+                    if k not in _NON_DEFINITION_FIELDS
+                },
+            }
+        elif isinstance(entry, (int, float)) and not isinstance(
+            entry, bool
+        ):
+            out[name] = {
+                "value": float(entry),
+                "source": None,  # legacy untagged round
+                "mode": None,
+                "unit": None,
+                "params": {},
+            }
+    _attach_legacy_modes(out, extra)
+    return out
+
+
+def definition_changed(a: dict, b: dict) -> bool:
+    """Did the MEASUREMENT change between two entries of one metric?
+
+    - both sides tagged with different sources -> changed; a
+      None->tagged source backfill alone is NOT a change (legacy
+      rounds were measured too — treating the r06 schema migration as
+      all-redefined would blind --check for exactly that round);
+    - mode stamp differs -> changed;
+    - both sides carry params and they differ (e.g. a different
+      BENCH_REPLAY_COMMITTEE) -> changed; a legacy side with no params
+      recorded cannot veto comparison."""
+    sa, sb = a.get("source"), b.get("source")
+    if sa is not None and sb is not None and sa != sb:
+        return True
+    if (a.get("mode") or None) != (b.get("mode") or None):
+        return True
+    pa, pb = a.get("params") or {}, b.get("params") or {}
+    return bool(pa and pb and pa != pb)
+
+
+def _round_number(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_rounds(paths: list) -> list:
+    """[(round_id, path, normalized)] in round order."""
+    rounds = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed", doc)  # driver wrapper or bare line
+        rid = doc.get("n", _round_number(path))
+        rounds.append((rid, path, normalize(parsed)))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def diff(rounds: list, threshold: float) -> list:
+    """Flags across every consecutive round pair."""
+    flags = []
+    for (ra, _, ma), (rb, _, mb) in zip(rounds, rounds[1:]):
+        for name in sorted(set(ma) | set(mb)):
+            a, b = ma.get(name), mb.get(name)
+            if a is None or b is None:
+                flags.append({
+                    "kind": "new" if a is None else "dropped",
+                    "metric": name, "from_round": ra, "to_round": rb,
+                })
+                continue
+            if definition_changed(a, b):
+                flags.append({
+                    "kind": "redefined", "metric": name,
+                    "from_round": ra, "to_round": rb,
+                    "prev": a["value"], "cur": b["value"],
+                    "prev_mode": [a.get("source"), a.get("mode"),
+                                  a.get("params")],
+                    "cur_mode": [b.get("source"), b.get("mode"),
+                                 b.get("params")],
+                })
+                continue
+            d = direction(name)
+            if d == 0 or a["value"] == 0:
+                continue
+            change = (b["value"] - a["value"]) / abs(a["value"])
+            worse = -change * d > threshold
+            better = change * d > threshold
+            if worse or better:
+                flags.append({
+                    "kind": "regression" if worse else "improvement",
+                    "metric": name, "from_round": ra, "to_round": rb,
+                    "prev": a["value"], "cur": b["value"],
+                    "change_pct": round(change * 100, 1),
+                })
+    return flags
+
+
+def run(paths: list, threshold: float) -> dict:
+    rounds = load_rounds(paths)
+    flags = diff(rounds, threshold)
+    regressions = [f for f in flags if f["kind"] == "regression"]
+    return {
+        "rounds": [
+            {"round": rid, "file": os.path.relpath(path, ROOT),
+             "metrics": metrics}
+            for rid, path, metrics in rounds
+        ],
+        "threshold_pct": round(threshold * 100, 1),
+        "flags": flags,
+        "ok": not regressions,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="BENCH round files (default: BENCH_r*.json "
+                         "in the repo root)")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="fractional change that flags (default 0.30; "
+                         "this box's vCPU jitters same-code runs by "
+                         "~20%% — see PERF_MODEL §5)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any regression flag (CI gate)")
+    args = ap.parse_args(argv)
+
+    paths = args.files or sorted(
+        glob.glob(os.path.join(ROOT, "BENCH_r*.json"))
+    )
+    if len(paths) < 2:
+        print(json.dumps({"rounds": [], "flags": [],
+                          "ok": True, "note": "fewer than 2 rounds"}))
+        return 0
+    report = run(paths, args.threshold)
+    print(json.dumps(report, indent=2))
+    if args.check and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

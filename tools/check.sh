@@ -25,6 +25,13 @@
 #      run CONCURRENTLY through the one shared device queue; the
 #      /metrics exposition must show harmony_sched_batch_fill_ratio
 #      above its floor and ZERO consensus-lane sheds.
+#   6. perf observability — the kernel-stage profiler + ledger tiers
+#      (tests/test_prof.py, tests/test_bench_ledger.py), then
+#      tools/loadgen.py --check (sustained-rate floor, tracer-derived
+#      p50<=p99 latency grammar, all three lanes active, zero
+#      consensus sheds) and tools/bench_ledger.py --check over the
+#      committed BENCH_r*.json rounds (machine-readable regression
+#      flags; measurement redefinitions are exempt).
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -59,5 +66,13 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_sched.py
 JAX_PLATFORMS=cpu python tools/sched_smoke.py
+
+echo "== perf observability: profiler tier + loadgen floors + bench ledger =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_prof.py \
+  tests/test_bench_ledger.py
+JAX_PLATFORMS=cpu python tools/loadgen.py --duration 5 --check
+python tools/bench_ledger.py --check > /dev/null
 
 echo "check.sh: OK"
