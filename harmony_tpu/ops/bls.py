@@ -27,6 +27,8 @@ from . import fp
 from . import pairing as PR
 from . import towers as T
 
+# graftlint: kernel-module dtype=int32; twin=harmony_tpu/ops/twin.py
+
 SK_BITS = 255  # ceil(log2 r)
 
 _H2_BITS = jnp.asarray([int(b) for b in bin(C.H2)[2:]], dtype=jnp.int32)

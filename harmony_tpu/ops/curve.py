@@ -26,6 +26,8 @@ from . import _constants as C
 from . import fp
 from . import towers as T
 
+# graftlint: kernel-module dtype=int32
+
 
 class FieldOps:
     """Vectorized field-op table the generic group law is written against."""
@@ -86,6 +88,7 @@ def _point(x, y, z, ops):
     return jnp.stack([x, y, z], axis=-(ops.coord_axes + 1))
 
 
+# graftlint: kernel bounds=(fieldops, any) -> limb; domain=(any, any) -> mont
 def infinity(ops, batch_shape=()):
     """Canonical infinity (1, 1, 0)."""
     one = ops.one(batch_shape)
@@ -98,6 +101,7 @@ def _select_point(mask, a, b, ops):
     )
 
 
+# graftlint: kernel bounds=(limb, fieldops) -> limb; domain=(mont, any) -> mont
 def dbl(pt, ops):
     """Jacobian doubling, a = 0 (dbl-2009-l).  Handles infinity (Z3 = 0
     follows from Z = 0 automatically)."""
@@ -118,6 +122,7 @@ def dbl(pt, ops):
     return _point(x3, y3, z3, ops)
 
 
+# graftlint: kernel bounds=(limb, limb, fieldops) -> limb; domain=(mont, mont, any) -> mont
 def add(p1, p2, ops, handle_equal=True):
     """Branchless Jacobian addition (add-2007-bl structure) with select-based
     handling of infinity / equal / opposite inputs.
@@ -180,11 +185,13 @@ def _batch_shape(pt, ops):
     return pt.shape[: pt.ndim - (ops.coord_axes + 1)]
 
 
+# graftlint: kernel bounds=(limb, fieldops) -> limb; domain=(mont, any) -> mont
 def neg(pt, ops):
     x, y, z = _coords(pt, ops)
     return _point(x, ops.neg(y), z, ops)
 
 
+# graftlint: kernel bounds=(limb, bit, fieldops) -> limb; domain=(mont, any, any) -> mont
 def scalar_mul(pt, bits, ops):
     """Double-and-add over an MSB-first bit tensor.
 
@@ -209,6 +216,7 @@ def scalar_mul(pt, bits, ops):
     return acc
 
 
+# graftlint: kernel bounds=(limb, fieldops) -> (limb, limb); domain=(mont, any) -> (mont, mont)
 def to_affine(pt, ops):
     """Jacobian -> affine (x, y); infinity maps to (0, 0)."""
     x, y, z = _coords(pt, ops)
@@ -223,6 +231,7 @@ def to_affine(pt, ops):
     return ax, ay
 
 
+# graftlint: kernel bounds=(limb, any, fieldops) -> limb; domain=(mont, any, any) -> mont
 def masked_sum(points, mask, ops):
     """Sum of points[i] where mask[i] == 1, via log-depth tree reduction.
 
@@ -255,6 +264,7 @@ def masked_sum(points, mask, ops):
 
 # --- generators ------------------------------------------------------------
 
+# graftlint: kernel bounds=limb; domain=mont
 G1_GEN = jnp.asarray(
     np.stack(
         [
@@ -265,6 +275,7 @@ G1_GEN = jnp.asarray(
     )
 )
 
+# graftlint: kernel bounds=limb; domain=mont
 G2_GEN = jnp.asarray(
     np.stack(
         [

@@ -4,8 +4,9 @@ Replaces mcl's x86 Montgomery assembly (reference: herumi mcl via
 go.mod:27) with a TPU-shaped design:
 
 - 32 limbs x 12 bits in int32 (see ops/limbs.py): every partial product
-  stays < 2^24 and every lazy accumulator < 2^30, so nothing needs the
-  64-bit multiplies TPUs lack.
+  stays < 2^24 and every lazy accumulator < 2^31 (graftlint GL09 proves
+  the scan accumulator <= 1.078e9, ~2x int32 headroom), so nothing
+  needs the 64-bit multiplies TPUs lack.
 - Montgomery multiplication is CIOS restructured as a *shift-based scan*:
   each of the 32 steps adds a_i * b + m_i * p to a 32-limb lazy
   accumulator and shifts one limb down — no dynamic indexing, identical
@@ -29,12 +30,15 @@ import numpy as np
 from . import _constants as C
 from .limbs import LIMB_BITS, LIMB_MASK, N_LIMBS, int_to_limbs
 
-P_LIMBS = jnp.asarray(int_to_limbs(C.P_INT))
-ONE_MONT = jnp.asarray(np.array(C.ONE_MONT, dtype=np.int32))
-R2 = jnp.asarray(np.array(C.R2_LIMBS, dtype=np.int32))
+# graftlint: kernel-module dtype=int32
+
+P_LIMBS = jnp.asarray(int_to_limbs(C.P_INT))  # graftlint: kernel domain=neutral
+ONE_MONT = jnp.asarray(np.array(C.ONE_MONT, dtype=np.int32))  # graftlint: kernel domain=mont
+R2 = jnp.asarray(np.array(C.R2_LIMBS, dtype=np.int32))  # graftlint: kernel domain=r2
 ZERO = jnp.zeros(N_LIMBS, dtype=jnp.int32)
-_ONE_RAW = jnp.asarray(int_to_limbs(1))  # 1 NOT in Montgomery form
-_P_INV_NEG = np.int32(C.P_INV_NEG)
+_ONE_RAW = jnp.asarray(int_to_limbs(1))  # graftlint: kernel domain=std
+
+_P_INV_NEG = np.int32(C.P_INV_NEG)  # graftlint: kernel bounds=limb
 
 # exponent bit arrays (MSB first) for fixed-exponent powering
 _P_MINUS_2_BITS = jnp.asarray(
@@ -64,6 +68,7 @@ def _lookahead(gen, prop):
     return _shift_in_zeros(g, 1)
 
 
+# graftlint: kernel bounds=(<2**13) -> limb; domain=(same) -> same
 def resolve_carries(s):
     """Exact digit normalization for limbs in [0, 2^13 - 1]: one
     carry-lookahead pass (carries are binary in this range)."""
@@ -73,8 +78,9 @@ def resolve_carries(s):
     return (s + carry_in) & LIMB_MASK
 
 
+# graftlint: kernel bounds=(<2**31) -> limb; domain=(same) -> same
 def normalize(t):
-    """Exact digits from lazy nonneg limbs < 2^30 (value must be < 2^384).
+    """Exact digits from lazy nonneg limbs < 2^31 (value must be < 2^384).
 
     Three value-halving rounds shrink carries to binary, then one
     lookahead pass finishes exactly.
@@ -88,6 +94,7 @@ def normalize(t):
     return resolve_carries(t)
 
 
+# graftlint: kernel bounds=(limb, limb) -> (limb, bit); domain=(same, same) -> (same, neutral)
 def _sub_exact(x, y):
     """(x - y) as exact digits plus the final borrow (1 iff x < y).
 
@@ -103,23 +110,27 @@ def _sub_exact(x, y):
     return out, borrow_out
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(same) -> same
 def cond_sub_p(a):
     """Map canonical digits with value in [0, 2p) to [0, p)."""
     diff, borrow = _sub_exact(a, P_LIMBS)
     return jnp.where(borrow[..., None] == 1, a, diff)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same
 def add(a, b):
     """Canonical modular addition."""
     return cond_sub_p(resolve_carries(a + b))
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(same) -> same
 def neg(a):
     """Canonical modular negation (p - a, with -0 = 0)."""
     diff, _ = _sub_exact(P_LIMBS, a)
     return cond_sub_p(diff)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same
 def sub(a, b):
     """Canonical modular subtraction."""
     return add(a, neg(b))
@@ -152,6 +163,7 @@ def get_backend() -> str:
     return _BACKEND
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=mul
 def mont_mul(a, b):
     """Montgomery product (a b R^-1 mod p) of canonical-digit operands.
 
@@ -191,20 +203,24 @@ def mont_mul(a, b):
     return cond_sub_p(normalize(t))
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def sqr(a):
     return mont_mul(a, a)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(std) -> mont
 def to_mont(a):
     """Enter the Montgomery domain: a -> a R mod p."""
     return mont_mul(a, R2)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> std
 def from_mont(a):
     """Leave the Montgomery domain: a R -> a."""
     return mont_mul(a, _ONE_RAW)
 
 
+# graftlint: kernel bounds=(limb, bit) -> limb; domain=(mont, any) -> mont
 def pow_fixed(a, exponent_bits):
     """a^e in the Montgomery domain, e given as a static MSB-first bit
     array; used for inversion and sqrt-style fixed exponents."""
@@ -221,16 +237,19 @@ def pow_fixed(a, exponent_bits):
     return acc
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def inv(a):
     """Modular inverse via Fermat: a^(p-2).  inv(0) = 0 (callers guard)."""
     return pow_fixed(a, _P_MINUS_2_BITS)
 
 
+# graftlint: kernel bounds=(limb) -> bit; domain=(any) -> neutral
 def is_zero(a):
     """Boolean (...,) mask: element == 0 (canonical digits assumed)."""
     return jnp.all(a == 0, axis=-1)
 
 
+# graftlint: kernel bounds=(any, limb, limb) -> limb; domain=(any, same, same) -> same
 def select(mask, x, y):
     """Branchless per-element select; mask shape (...,), operands (..., 32)."""
     return jnp.where(mask[..., None], x, y)

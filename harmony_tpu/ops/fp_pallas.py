@@ -24,11 +24,14 @@ from jax.experimental import pallas as pl
 from . import _constants as C
 from .limbs import LIMB_BITS, LIMB_MASK, N_LIMBS, int_to_limbs
 
+# graftlint: kernel-module dtype=int32
+
 _LANES = 128
 _P_COL = int_to_limbs(C.P_INT).reshape(N_LIMBS, 1)  # (32, 1) np array
 _P_INV_NEG = C.P_INV_NEG
 
 
+# graftlint: kernel bounds=(limb, limb, limb, limb) -> limb; domain=mul
 def _mont_mul_kernel(a_ref, b_ref, p_ref, out_ref):
     """One (32, LANES) tile: full CIOS, unrolled, accumulator in VMEM."""
     a = a_ref[:, :]
@@ -99,6 +102,7 @@ def _apply_borrows(d):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+# graftlint: kernel bounds=(limb, limb, any) -> limb; domain=mul
 def mont_mul_pallas(a, b, interpret: bool = False):
     """Montgomery product over the framework layout (..., 32).
 

@@ -31,6 +31,9 @@ from . import _constants as C
 from . import fp
 from . import towers as T
 
+# graftlint: kernel-module dtype=int32
+
+# graftlint: kernel bounds=(any) -> (<64, bit); domain=any; trusted
 def _schedule(e: int):
     """Square-and-multiply schedule of a STATIC exponent as two equal-
     length arrays: per segment, the number of squarings, then whether a
@@ -148,6 +151,7 @@ def _add_step(x, y, z, xq, yq, xp_m, yp_m):
     return (x3, y3, z3), (c_v2, c_w, c_wv)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def miller_loop(p_aff, q_aff):
     """f_{|x|,Q}(P), conjugated for x < 0.  Finite affine inputs only:
     p_aff (..., 2, 32) over Fp, q_aff (..., 2, 2, 32) over Fp2.
@@ -189,6 +193,7 @@ def miller_loop(p_aff, q_aff):
     return T.fp12_conj(carry[0])
 
 
+# graftlint: kernel bounds=(limb, any) -> limb; domain=(mont, any) -> mont
 def _cyclo_pow_abs(a, sched):
     """a^e for a STATIC positive exponent given as its square-and-
     multiply schedule, with Granger-Scott cyclotomic squarings — valid
@@ -209,6 +214,7 @@ def _cyclo_pow_abs(a, sched):
     return acc
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def final_exponentiation(f):
     """f^(3 (p^12-1)/r): easy part exactly, hard part by the x-chain.
 
@@ -238,11 +244,13 @@ def final_exponentiation(f):
     return T.fp12_mul(m4, T.fp12_mul(T.fp12_sqr(f2), f2))  # * f2^3
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def pairing(p_aff, q_aff):
     """Batched full pairing e(P, Q)."""
     return final_exponentiation(miller_loop(p_aff, q_aff))
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def pairing_product(p_aff, q_aff):
     """prod_k e(P_k, Q_k) over the FIRST axis, one shared final
     exponentiation — the aggregate-verify shape (reference:
@@ -252,6 +260,7 @@ def pairing_product(p_aff, q_aff):
     return final_exponentiation(fp12_tree_reduce(fs))
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp12_tree_reduce(fs):
     """Log-depth product of Fp12 elements over the first axis."""
     while fs.shape[0] > 1:
@@ -266,6 +275,7 @@ def fp12_tree_reduce(fs):
     return fs[0]
 
 
+# graftlint: kernel bounds=(limb) -> bit; domain=(any) -> neutral
 def is_one(gt):
     """Boolean mask: GT element == 1 (canonical Montgomery digits)."""
     one = T.fp12_one(gt.shape[:-4])

@@ -19,20 +19,25 @@ Montgomery domain throughout.
 import jax.numpy as jnp
 import numpy as np
 
+# graftlint: kernel-module dtype=int32
+
 from . import _constants as C
 from . import fp
 
 # --- Fp2 -------------------------------------------------------------------
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp2_add(a, b):
     return fp.add(a, b)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp2_sub(a, b):
     return fp.sub(a, b)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp2_neg(a):
     return fp.neg(a)
 
@@ -41,6 +46,7 @@ def _split2(a):
     return a[..., 0, :], a[..., 1, :]
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp2_mul(a, b):
     """Karatsuba: 3 stacked Fp muls."""
     a, b = jnp.broadcast_arrays(a, b)
@@ -54,6 +60,7 @@ def fp2_mul(a, b):
     return jnp.stack([c0, c1], axis=-2)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp2_sqr(a):
     """Complex squaring: (a0+a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u —
     2 stacked Fp muls."""
@@ -64,17 +71,20 @@ def fp2_sqr(a):
     return jnp.stack([v[0], v[1]], axis=-2)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp2_conj(a):
     a0, a1 = _split2(a)
     return jnp.stack([a0, fp.neg(a1)], axis=-2)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp2_mul_xi(a):
     """Multiply by xi = u + 1: (a0 - a1) + (a0 + a1) u."""
     a0, a1 = _split2(a)
     return jnp.stack([fp.sub(a0, a1), fp.add(a0, a1)], axis=-2)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp2_inv(a):
     a0, a1 = _split2(a)
     sq = fp.mont_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
@@ -83,19 +93,23 @@ def fp2_inv(a):
     return jnp.stack([prod[0], fp.neg(prod[1])], axis=-2)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> neutral
 def fp2_zero(batch_shape=()):
     return jnp.zeros((*batch_shape, 2, fp.N_LIMBS), dtype=jnp.int32)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> mont
 def fp2_one(batch_shape=()):
     one = jnp.broadcast_to(fp.ONE_MONT, (*batch_shape, fp.N_LIMBS))
     return jnp.stack([one, jnp.zeros_like(one)], axis=-2)
 
 
+# graftlint: kernel bounds=(limb) -> bit; domain=(any) -> neutral
 def fp2_is_zero(a):
     return jnp.all(a == 0, axis=(-1, -2))
 
 
+# graftlint: kernel bounds=(any, limb, limb) -> limb; domain=(any, same, same) -> same
 def fp2_select(mask, x, y):
     return jnp.where(mask[..., None, None], x, y)
 
@@ -107,18 +121,22 @@ def _split3(a):
     return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp6_add(a, b):
     return fp.add(a, b)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp6_sub(a, b):
     return fp.sub(a, b)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp6_neg(a):
     return fp.neg(a)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp6_mul(a, b):
     """Karatsuba-3: 6 stacked Fp2 muls (18 Fp muls in one scan)."""
     a, b = jnp.broadcast_arrays(a, b)
@@ -138,12 +156,14 @@ def fp6_mul(a, b):
     return jnp.stack([c0, c1, c2], axis=-3)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp6_mul_v(a):
     """Multiply by v: (c0, c1, c2) -> (xi c2, c0, c1)."""
     a0, a1, a2 = _split3(a)
     return jnp.stack([fp2_mul_xi(a2), a0, a1], axis=-3)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp6_inv(a):
     a0, a1, a2 = _split3(a)
     sq = fp2_mul(jnp.stack([a0, a2, a1]), jnp.stack([a0, a2, a1]))
@@ -158,10 +178,12 @@ def fp6_inv(a):
     return jnp.stack([out[0], out[1], out[2]], axis=-3)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> neutral
 def fp6_zero(batch_shape=()):
     return jnp.zeros((*batch_shape, 3, 2, fp.N_LIMBS), dtype=jnp.int32)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> mont
 def fp6_one(batch_shape=()):
     return jnp.stack(
         [fp2_one(batch_shape), fp2_zero(batch_shape), fp2_zero(batch_shape)],
@@ -176,14 +198,17 @@ def _split12(a):
     return a[..., 0, :, :, :], a[..., 1, :, :, :]
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp12_add(a, b):
     return fp.add(a, b)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp12_sub(a, b):
     return fp.sub(a, b)
 
 
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, mont) -> mont
 def fp12_mul(a, b):
     """Karatsuba-2 over Fp6: 3 stacked Fp6 muls = one 54-product scan."""
     a, b = jnp.broadcast_arrays(a, b)
@@ -197,6 +222,7 @@ def fp12_mul(a, b):
     return jnp.stack([c0, c1], axis=-4)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp12_sqr(a):
     """Complex-method squaring: (a0 + a1 w)^2 with w^2 = v needs only
     TWO Fp6 products (vs three for a general mul):
@@ -218,12 +244,14 @@ def fp12_sqr(a):
     return jnp.stack([c0, c1], axis=-4)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp12_conj(a):
     """x -> x^(p^6): negate the w coefficient."""
     a0, a1 = _split12(a)
     return jnp.stack([a0, fp.neg(a1)], axis=-4)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp12_cyclo_sqr(a):
     """Granger-Scott squaring for UNITARY elements (the cyclotomic
     subgroup every final-exp intermediate lives in after the easy part):
@@ -271,6 +299,7 @@ def fp12_cyclo_sqr(a):
     return jnp.stack([lo, hi], axis=-4)
 
 
+# graftlint: kernel bounds=(limb) -> limb; domain=(mont) -> mont
 def fp12_inv(a):
     a0, a1 = _split12(a)
     sq = fp6_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
@@ -280,14 +309,17 @@ def fp12_inv(a):
     return jnp.stack([out[0], fp6_neg(out[1])], axis=-4)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> neutral
 def fp12_zero(batch_shape=()):
     return jnp.zeros((*batch_shape, 2, 3, 2, fp.N_LIMBS), dtype=jnp.int32)
 
 
+# graftlint: kernel bounds=(any) -> limb; domain=(any) -> mont
 def fp12_one(batch_shape=()):
     return jnp.stack([fp6_one(batch_shape), fp6_zero(batch_shape)], axis=-4)
 
 
+# graftlint: kernel bounds=(any, limb, limb) -> limb; domain=(any, same, same) -> same
 def fp12_select(mask, x, y):
     return jnp.where(mask[..., None, None, None, None], x, y)
 
@@ -296,7 +328,7 @@ def fp12_select(mask, x, y):
 
 # FROB_GAMMA[k-1][m] = xi^(m (p^k - 1)/6) as Fp2; coefficient of w^i v^j
 # gets multiplied by gamma_k[i + 2 j] after k-fold conjugation.
-_GAMMA = jnp.asarray(np.array(C.FROB_GAMMA, dtype=np.int32))  # (3, 6, 2, 32)
+_GAMMA = jnp.asarray(np.array(C.FROB_GAMMA, dtype=np.int32))  # (3, 6, 2, 32)  # graftlint: kernel domain=mont
 
 # rearrange to (k, i_w, j_v, 2, 32) with m = i + 2 j
 _GAMMA_TENSOR = jnp.stack(
@@ -308,6 +340,7 @@ _GAMMA_TENSOR = jnp.stack(
 )  # (3, 2, 3, 2, 32)
 
 
+# graftlint: kernel bounds=(limb, any) -> limb; domain=(mont, any) -> mont
 def fp12_frobenius(a, k=1):
     """a^(p^k) for k = 1, 2, 3 via precomputed gamma constants."""
     if k not in (1, 2, 3):
@@ -320,6 +353,7 @@ def fp12_frobenius(a, k=1):
     return fp2_mul(a, _GAMMA_TENSOR[k - 1])
 
 
+# graftlint: kernel bounds=(limb, bit) -> limb; domain=(mont, any) -> mont
 def fp12_pow(a, exponent_bits):
     """a^e for a static MSB-first bit array (select-based, scan)."""
     import jax

@@ -1,0 +1,70 @@
+"""GL09 fixture: limb value-range abstract interpretation.
+
+Each tagged line must be flagged exactly there; everything else must
+stay quiet.  The centerpiece is the seeded Karatsuba-shaped
+overflow: two-level 32->16->8 digit-sum splitting feeds sums-of-sums
+(<= 3*4095 = 12285) into a limb convolution, whose 32-term accumulator
+is provably 12285^2 * 32 = 4.83e9 > int32 — the exact silent-overflow
+class the Karatsuba/MXU kernel optimizations can introduce.  The
+guarded twin resolves carries back to ~12-bit digits first and must
+NOT be flagged (4097^2 * 32 = 5.4e8 fits).
+"""
+# graftlint: kernel-module dtype=int32
+
+import jax.numpy as jnp
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def _resolve_once(s):
+    """One carry round: lazy digits <= 2^14 back to <= 2^12 + 3."""
+    q = s >> LIMB_BITS
+    r = s & LIMB_MASK
+    return r + jnp.concatenate(
+        [jnp.zeros_like(q[..., :1]), q[..., :-1]], axis=-1
+    )
+
+
+# graftlint: kernel bounds=(limb, limb) -> any; domain=(std, std) -> any
+def kara_convolution_unguarded(a, b):
+    """Two-level Karatsuba split WITHOUT re-reducing the digit sums."""
+    sa = (a + a) + a  # models (a_lo + a_hi) + carry-folded second split
+    sb = (b + b) + b
+    return jnp.einsum("...i,...i->...", sa, sb)  # expect: GL09
+
+
+# graftlint: kernel bounds=(limb, limb) -> any; domain=(std, std) -> any
+def kara_convolution_guarded(a, b):
+    """Same shape, digit sums carry-resolved before the convolution —
+    the accumulator provably fits int32; must NOT be flagged."""
+    sa = _resolve_once((a + a) + a)
+    sb = _resolve_once((b + b) + b)
+    return jnp.einsum("...i,...i->...", sa, sb)
+
+
+# graftlint: kernel bounds=(<2**16, <2**16) -> any; domain=any
+def plane_recombine_unguarded(hi_plane, lo_plane):
+    """int8-plane recombination done as a raw 16x16-bit product."""
+    return hi_plane * lo_plane  # expect: GL09
+
+
+# graftlint: kernel bounds=(<2**13) -> limb; domain=(same) -> same; trusted
+def resolve13(s):
+    """Stand-in for fp.resolve_carries: exact for inputs < 2^13."""
+    return s & LIMB_MASK
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same
+def triple_add_bad(a, b):
+    return resolve13(a + b + b)  # expect: GL09
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same
+def triple_add_reviewed(a, b):
+    return resolve13(a + b + b)  # graftlint: disable=GL09 b is pre-halved upstream
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same
+def double_add_ok(a, b):
+    return resolve13(a + b)  # 8190 < 2^13: clean

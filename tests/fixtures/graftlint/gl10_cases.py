@@ -1,0 +1,65 @@
+"""GL10 fixture: Montgomery-domain typestate.
+
+Every field value carries an R-degree (x * R^d): std d=0, mont d=1,
+the R^2 conversion constant d=2.  ``mmul`` is the degree primitive
+(d_out = d_a + d_b - 1); add/select require matching degrees.  The
+tagged lines are the four defect classes: a conversion that lands in
+the wrong domain, arithmetic mixing domains, a raw ``*`` product of
+domain values, and a degree that leaves {0, 1, 2}.
+"""
+# graftlint: kernel-module dtype=int32
+
+import jax.numpy as jnp
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+ONE_M = jnp.asarray([1] * 32, dtype=jnp.int32)  # graftlint: kernel bounds=limb; domain=mont
+R2C = jnp.asarray([2] * 32, dtype=jnp.int32)  # graftlint: kernel bounds=limb; domain=r2
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=mul; trusted
+def mmul(a, b):
+    """Montgomery-product stand-in (degree algebra primitive)."""
+    return a
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(same, same) -> same; trusted
+def fadd(a, b):
+    """Canonical modular addition stand-in."""
+    return a
+
+
+# graftlint: kernel bounds=(limb) -> limb; domain=(std) -> mont
+def to_mont_ok(a):
+    return mmul(a, R2C)  # 0 + 2 - 1 = mont: clean
+
+
+# graftlint: kernel bounds=(limb) -> limb; domain=(std) -> mont
+def to_mont_missing_r2(a):  # expect: GL10
+    return mmul(a, ONE_M)  # 0 + 1 - 1 = std, contract says mont
+
+
+# graftlint: kernel bounds=(limb, limb) -> limb; domain=(mont, std) -> mont
+def mixed_add(am, bs):  # expect: GL10
+    return fadd(am, bs)  # expect: GL10
+
+
+# graftlint: kernel bounds=(limb, limb) -> any; domain=(mont, mont) -> any
+def raw_product(a, b):
+    return (a * b) & LIMB_MASK  # expect: GL10
+
+
+# graftlint: kernel bounds=() -> any; domain=any
+def r3_degree():
+    return mmul(R2C, R2C)  # expect: GL10
+
+
+# graftlint: kernel bounds=(any, limb, limb) -> any; domain=(any, mont, std) -> any
+def select_mixed(m, x, y):
+    return jnp.where(m[..., None], x, y)  # expect: GL10
+
+
+# graftlint: kernel bounds=(limb, limb) -> any; domain=(mont, std) -> any
+def mixed_add_reviewed(am, bs):
+    return fadd(am, bs)  # graftlint: disable=GL10 boundary conversion audited

@@ -1,0 +1,41 @@
+"""GL11 fixture: twin/padding discipline for device-dispatched kernels.
+
+This file declares ITSELF as its twin module (single-file mode: the
+twin of ``verify_x`` is ``verify_x_twin``), skips the parity-test scan
+(``tests=skip`` — the repo-level scan is exercised against the real
+tree in tests/test_graftlint.py), and lists the dispatched kernels
+explicitly.  The tagged lines are a kernel with no twin and a kernel
+whose dataflow never reaches an infinity-sentinel guard.
+"""
+# graftlint: kernel-module dtype=int32; twin=tests/fixtures/graftlint/gl11_cases.py; tests=skip; dispatch=verify_ok, verify_no_twin, verify_no_guard
+
+import jax.numpy as jnp
+
+
+# graftlint: kernel padding-safe
+def _finite_mask(pk):
+    """Reviewed infinity-sentinel check: (0, 0) lanes are padding."""
+    return ~jnp.all(pk == 0, axis=(-1, -2))
+
+
+def verify_ok(pk, sig):
+    """Twin present, guard reached: must stay quiet."""
+    return jnp.where(_finite_mask(pk), sig[..., 0, 0],
+                 jnp.zeros_like(sig[..., 0, 0]))
+
+
+def verify_ok_twin(pk, sig):
+    return [bool(p.any()) for p in pk]
+
+
+def verify_no_twin(pk, sig):  # expect: GL11
+    return jnp.where(_finite_mask(pk), sig[..., 0, 0],
+                     jnp.zeros_like(sig[..., 0, 0]))
+
+
+def verify_no_guard(pk, sig):  # expect: GL11
+    return sig[..., 0, 0]
+
+
+def verify_no_guard_twin(pk, sig):
+    return [bool(p.any()) for p in pk]

@@ -2,10 +2,16 @@
 # Pre-commit gate for harmony-tpu.
 #
 # Three stages, fail-fast:
-#   1. graftlint — whole-program static analysis (GL01-GL08) against
-#      the committed baseline.  Exit-code contract (stable for hooks):
-#      0 clean, 1 new violations, 2 internal linter error — any
-#      non-zero stops this script with the same code.
+#   1. graftlint — whole-program static analysis (GL01-GL11: the
+#      classic families plus the kernelcheck pass — GL09 limb
+#      value-range abstract interpretation, GL10 Montgomery-domain
+#      typestate, GL11 twin/padding discipline) against the committed
+#      baseline.  Exit-code contract (stable for hooks): 0 clean,
+#      1 new violations, 2 internal linter error — any non-zero stops
+#      this script with the same code.  This stage warms the
+#      content-hash result cache (.graftlint_cache.json), so the
+#      tier-1 test_graftlint repo gate in stage 2 re-answers from it
+#      instead of re-analyzing an unchanged tree.
 #   2. tier-1 smoke subset — the fast, pure-CPU slices that catch the
 #      classes of regression this repo's PRs most often introduce
 #      (linter self-tests, device-path wiring, thread-safety, codecs).

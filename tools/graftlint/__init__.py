@@ -17,6 +17,12 @@ they corrupt a signature or deadlock consensus:
 - Silent failure hygiene (GL04): a bare ``except:`` (or
   ``except Exception: pass``) in a consensus or crypto path turns a
   signature bug into an undiagnosable liveness stall.
+- Kernel-domain safety (GL09-GL11, kernelcheck.py): a limb
+  intermediate whose proven bound can leave int32 (GL09, interval
+  abstract interpretation over the jnp dataflow), Montgomery-domain
+  mixing or missing conversions (GL10, R-degree typestate), and
+  device-dispatched kernels without a bigint twin, parity test or
+  infinity-padding guard (GL11).
 
 Usage (CLI)::
 
@@ -43,4 +49,4 @@ from .engine import (  # noqa: F401
     RULES,
 )
 
-__version__ = "1.0"
+__version__ = "1.1"

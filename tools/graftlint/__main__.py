@@ -54,6 +54,9 @@ def _parse_args(argv):
     ap.add_argument("--dot", action="store_true",
                     help="emit the whole-program call graph as DOT on "
                          "stdout and exit 0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the content-hash result cache "
+                         "(.graftlint_cache.json) and re-analyze")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the summary line")
     return ap.parse_args(argv)
@@ -108,7 +111,8 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()
     program_out: list = [] if args.dot else None
-    result = lint_paths(args.paths, only, program_out=program_out)
+    result = lint_paths(args.paths, only, program_out=program_out,
+                        use_cache=not args.no_cache)
 
     if result.errors:
         for err in result.errors:

@@ -1,0 +1,139 @@
+"""Content-hash-keyed result cache for the whole-program pass.
+
+``tools/check.sh`` runs the full-repo lint twice (the CLI gate, then
+the tier-1 ``tests/test_graftlint.py`` gate in a second process); each
+pass costs ~4s of parsing + interprocedural closure + kernelcheck.
+The findings are a pure function of (linted file contents, linter
+source, rule subset), so the second run — and every run on an
+unchanged tree — can be answered from a cache keyed on exactly that.
+
+Soundness: the key is a sha256 over every linted file's content hash
+PLUS a hash of the linter's own sources (``tools/graftlint/*.py``), so
+editing any linted file, adding/removing a file, or changing any rule
+invalidates the entry.  There is no per-file reuse of whole-program
+results — GL05–GL11 facts flow across files, so a one-file change
+re-analyzes the program (the cache's job is the unchanged-tree case;
+changed files are re-read and re-hashed every run regardless).
+
+Storage: one JSON file (default ``<repo>/.graftlint_cache.json``,
+gitignored), at most ``_MAX_ENTRIES`` entries evicted FIFO, written
+atomically via rename.  Every failure mode (corrupt JSON, unwritable
+dir, permission) degrades to a cache miss — the cache can never make
+the gate wrong or break it.  ``--no-cache`` or ``GRAFTLINT_CACHE=0``
+bypasses it entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+
+_MAX_ENTRIES = 8
+_VERSION = 1
+
+_MEM: dict[str, tuple] = {}  # in-process memo (same key space)
+_linter_sha: str | None = None
+
+
+def cache_path() -> Path | None:
+    """Resolve the cache file; None when disabled via env."""
+    from .engine import REPO_ROOT
+
+    env = os.environ.get("GRAFTLINT_CACHE")
+    if env is not None:
+        if env.strip().lower() in ("0", "off", "no", ""):
+            return None
+        return Path(env)
+    return REPO_ROOT / ".graftlint_cache.json"
+
+
+def linter_sha() -> str:
+    """Hash of the linter's own sources: rule changes invalidate."""
+    global _linter_sha
+    if _linter_sha is None:
+        h = hashlib.sha256()
+        here = Path(__file__).resolve().parent
+        for f in sorted(here.glob("*.py")):
+            h.update(f.name.encode())
+            h.update(f.read_bytes())
+        _linter_sha = h.hexdigest()
+    return _linter_sha
+
+
+def program_key(file_shas: list[tuple[str, str]],
+                only_rules) -> str:
+    """One key for a whole lint invocation."""
+    h = hashlib.sha256()
+    h.update(f"v{_VERSION}".encode())
+    h.update(linter_sha().encode())
+    h.update(repr(sorted(only_rules) if only_rules else None).encode())
+    for rel, sha in sorted(file_shas):
+        h.update(rel.encode())
+        h.update(sha.encode())
+    return h.hexdigest()
+
+
+def file_sha(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+
+def get(key: str):
+    """(findings_rows, errors) or None on miss."""
+    if key in _MEM:
+        return _MEM[key]
+    path = cache_path()
+    if path is None:
+        return None
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+        entry = data["entries"][key]
+        out = (entry["findings"], entry["errors"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    _MEM[key] = out
+    return out
+
+
+def put(key: str, findings_rows: list, errors: list) -> None:
+    _MEM[key] = (findings_rows, errors)
+    path = cache_path()
+    if path is None:
+        return
+    try:
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+            if not isinstance(data.get("entries"), dict):
+                raise ValueError("bad cache shape")
+        except (OSError, ValueError):
+            data = {"version": _VERSION, "entries": {}, "order": []}
+        entries = data["entries"]
+        order = [k for k in data.get("order", []) if k in entries]
+        if key in entries:
+            order = [k for k in order if k != key]
+        entries[key] = {"findings": findings_rows, "errors": errors}
+        order.append(key)
+        while len(order) > _MAX_ENTRIES:
+            entries.pop(order.pop(0), None)
+        data["order"] = order
+        fd, tmp = tempfile.mkstemp(
+            dir=str(path.parent), prefix=path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(data, fh)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        pass  # cache write failure must never fail the lint
+
+
+def clear_memory() -> None:
+    """Test hook: drop the in-process memo (disk cache untouched)."""
+    _MEM.clear()

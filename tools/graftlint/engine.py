@@ -37,8 +37,15 @@ RULES = {
     "GL07": "hot path: no per-item device->host syncs in loops",
     "GL08": "bounded blocking: socket connect/recv and urlopen must "
             "have a timeout ever set",
+    "GL09": "limb value-range: every kernel intermediate's proven "
+            "bound must fit the module dtype's lanes",
+    "GL10": "Montgomery-domain typestate: no mixing mont/std/R^2 "
+            "values, declared domains hold",
+    "GL11": "twin discipline: device-dispatched kernels need a twin, "
+            "a parity test and a provable padding guard",
 }
 INTERPROC_RULES = {"GL05", "GL06", "GL07", "GL08"}
+KERNEL_RULES = {"GL09", "GL10", "GL11"}
 
 # -- rule scoping over harmony_tpu/ -----------------------------------------
 
@@ -82,6 +89,10 @@ def _rule_applies(rule: str, relpath: str) -> bool:
     if rule in INTERPROC_RULES:
         # whole-program rules self-limit by semantics (locks held,
         # hot-path reachability) — every module participates
+        return True
+    if rule in KERNEL_RULES:
+        # kernelcheck self-limits to modules carrying a
+        # ``# graftlint: kernel-module`` contract
         return True
     return False
 
@@ -180,8 +191,8 @@ def _interproc_findings(sources: dict, supps: dict,
     """Whole-program pass over {relpath: (source, tree)}."""
     from . import interproc as IP
 
-    wanted = INTERPROC_RULES if only_rules is None \
-        else INTERPROC_RULES & only_rules
+    whole = INTERPROC_RULES | KERNEL_RULES
+    wanted = whole if only_rules is None else whole & only_rules
     if not wanted and program_out is None:
         return []
     prog = IP.analyze(sources)
@@ -196,6 +207,11 @@ def _interproc_findings(sources: dict, supps: dict,
         raw += IP.gl07_findings(prog)
     if "GL08" in wanted:
         raw += IP.gl08_findings(prog)
+    if wanted & KERNEL_RULES:
+        from . import kernelcheck as KC
+
+        raw += [f for f in KC.kernel_findings(prog)
+                if f.rule in wanted]
     findings = []
     for sf in raw:
         if not _rule_applies(sf.rule, sf.relpath):
@@ -241,29 +257,90 @@ def _iter_py_files(paths: list[str | Path]) -> tuple[list[Path], list[str]]:
     return files, bad
 
 
+# Cheap SUPERSET of kernelcheck.parse_module_anno's tests= clause:
+# matches any graftlint comment line naming tests=<dir> (even inside a
+# string literal).  Over-matching only adds aux hashes — a spurious
+# cache invalidation, never a stale hit; under-matching would be a
+# wrong-gate bug, so tests/test_graftlint.py pins the two parsers in
+# sync (test_cache_aux_regex_covers_module_anno_grammar).
+_TESTS_OVERRIDE_RE = re.compile(
+    r"graftlint:[^\n]*\btests=([^\s;]+)")
+
+
+def _aux_inputs_sha(texts: dict) -> list[tuple[str, str]]:
+    """Non-linted inputs whole-program rules read from disk (GL11's
+    parity-test scan of tests/*.py, plus any ``tests=`` override dir a
+    kernel-module annotation names) — they must key the cache too."""
+    from . import cache as CA
+
+    roots = {REPO_ROOT / "tests"}
+    for src in texts.values():
+        for m in _TESTS_OVERRIDE_RE.finditer(src):
+            if m.group(1) != "skip":
+                roots.add(REPO_ROOT / m.group(1))
+    out = []
+    for root in sorted(roots, key=str):
+        if not root.is_dir():
+            continue
+        for f in sorted(root.glob("*.py")):
+            try:
+                out.append(("aux:" + f.as_posix(),
+                            CA.file_sha(f.read_text(encoding="utf-8"))))
+            except OSError:
+                continue
+    return out
+
+
 def lint_paths(paths: list[str | Path],
                only_rules: set[str] | None = None,
-               program_out: list | None = None) -> LintResult:
+               program_out: list | None = None,
+               use_cache: bool = False) -> LintResult:
     """Lint files/dirs.  The union of resolved files is ONE program:
     intra-file rules run per file, then the interprocedural pass (call
-    graph, GL05-GL07) runs across all of them together.  Pass a list as
-    ``program_out`` to receive the analyzed Program (for --dot)."""
+    graph, GL05-GL11) runs across all of them together.  Pass a list as
+    ``program_out`` to receive the analyzed Program (for --dot).
+
+    ``use_cache=True`` answers from the content-hash-keyed result cache
+    (tools/graftlint/cache.py) when nothing — the linted files, the
+    tests/ tree GL11 reads, or the linter itself — has changed."""
     import ast
 
     result = LintResult()
     files, bad = _iter_py_files(paths)
     result.errors.extend(bad)
-    sources: dict = {}
-    supps: dict = {}
+    texts: dict = {}
     for f in files:
         try:
             rel = f.resolve().relative_to(REPO_ROOT).as_posix()
         except ValueError:
             rel = f.as_posix()
         try:
-            source = f.read_text(encoding="utf-8")
+            texts[rel] = f.read_text(encoding="utf-8")
+        except (UnicodeDecodeError, OSError) as e:
+            result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+
+    key = None
+    if use_cache and program_out is None and not result.errors:
+        # any path/read error bypasses the cache: the key could not
+        # represent the unreadable input
+        from . import cache as CA
+
+        shas = [(rel, CA.file_sha(src)) for rel, src in texts.items()]
+        key = CA.program_key(shas + _aux_inputs_sha(texts), only_rules)
+        hit = CA.get(key)
+        if hit is not None:
+            rows, errors = hit
+            result.findings = [Finding(*row) for row in rows]
+            result.errors.extend(errors)
+            result.findings.sort()
+            return result
+
+    sources: dict = {}
+    supps: dict = {}
+    for rel, source in texts.items():
+        try:
             tree = ast.parse(source, filename=rel)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        except SyntaxError as e:
             result.errors.append(f"{rel}: {type(e).__name__}: {e}")
             continue
         sources[rel] = (source, tree)
@@ -273,6 +350,14 @@ def lint_paths(paths: list[str | Path],
     result.findings.extend(
         _interproc_findings(sources, supps, only_rules, program_out))
     result.findings.sort()
+
+    if key is not None:
+        from . import cache as CA
+
+        CA.put(key,
+               [[f.path, f.line, f.col, f.rule, f.message, f.context,
+                 f.detail] for f in result.findings],
+               list(result.errors))
     return result
 
 
