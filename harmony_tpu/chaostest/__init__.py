@@ -1,0 +1,31 @@
+"""Adversarial scenario framework: composed chaos at mainnet shape.
+
+Public surface:
+
+* :mod:`.scenario` — the declarative vocabulary (Topology, Traffic,
+  Phase, Invariants, Scenario);
+* :mod:`.scenarios` — the five named roadmap scenarios + ``SCENARIOS``
+  registry;
+* :mod:`.runner` — ``run(scenario) -> ScenarioResult``;
+* :mod:`.fixtures` — deterministic builders shared with the unit
+  tiers (election fixtures, flood shapes).
+
+Driven by ``tools/chaos_sweep.py`` (check.sh stage 7); the scenario ×
+fault × invariant matrix is documented in docs/ANALYSIS.md.
+"""
+
+from .runner import RunEnv, ScenarioResult, run
+from .scenario import Invariants, Phase, Scenario, Topology, Traffic
+from .scenarios import SCENARIOS
+
+__all__ = [
+    "Invariants",
+    "Phase",
+    "RunEnv",
+    "Scenario",
+    "ScenarioResult",
+    "SCENARIOS",
+    "Topology",
+    "Traffic",
+    "run",
+]

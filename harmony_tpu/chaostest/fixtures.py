@@ -1,0 +1,126 @@
+"""Deterministic fixture builders shared by the chaos runner and the
+unit tiers (tests/test_staking_shard.py reuses the election fixtures so
+the committee-rotation-at-epoch-boundary case and the
+election-under-load scenario exercise the SAME wiring)."""
+
+from __future__ import annotations
+
+
+def staking_finalizer(genesis, ecdsa_keys, *, shard_count: int = 1,
+                      external_slots: int = 2):
+    """A Finalizer whose harmony accounts are the dev genesis committee
+    — the epoch-boundary election setup of tests/test_finalize.py, in
+    one place."""
+    from ..chain.finalize import FinalizeConfig, Finalizer
+
+    harmony_accounts = [
+        (k.address(), pub)
+        for k, pub in zip(ecdsa_keys, genesis.committee)
+    ]
+    return Finalizer(FinalizeConfig(
+        block_reward=28 * 10**18,
+        shard_count=shard_count,
+        external_slots_per_shard=external_slots,
+        harmony_accounts=harmony_accounts,
+    ))
+
+
+def external_bls_key(seed: int, index: int = 0):
+    """The i-th external validator key of a scenario seed."""
+    from .. import bls as B
+
+    return B.PrivateKey.generate(
+        b"chaos-external-bls-%d-%d" % (seed, index)
+    )
+
+
+def external_validator_stake(staker_key, ext_bls, *, nonce: int = 0,
+                             chain_id: int = 2):
+    """A signed CREATE_VALIDATOR registering ``ext_bls`` with its BLS
+    proof-of-possession — once committed and the election block passes,
+    the epoch committee rotates to include the external key."""
+    from .. import bls as B
+    from ..core.types import Directive, StakingTransaction
+
+    return StakingTransaction(
+        nonce=nonce, gas_price=1, gas_limit=50_000,
+        directive=Directive.CREATE_VALIDATOR,
+        fields={
+            "amount": 10**20,
+            "min_self_delegation": 10**18,
+            "bls_keys": ext_bls.pub.bytes,
+            "bls_key_sigs": B.proof_of_possession(ext_bls),
+        },
+    ).sign(staker_key, chain_id)
+
+
+def advance_with_full_bitmaps(chain, pool, n: int = 1):
+    """Commit ``n`` worker-proposed blocks with full-participation
+    commit proofs stored, so the next block's finalize consumes a real
+    bitmap (the shape consensus produces live)."""
+    from ..node.worker import Worker
+
+    worker = Worker(chain, pool)
+    for _ in range(n):
+        block = worker.propose_block(view_id=chain.head_number + 1)
+        if chain.insert_chain([block], verify_seals=False) != 1:
+            raise RuntimeError(f"insert failed at {block.block_num}")
+        committee = chain.committee_for_epoch(
+            chain.epoch_of(block.block_num)
+        )
+        nbytes = (len(committee) + 7) >> 3
+        full = bytearray([0xFF] * nbytes)
+        extra = nbytes * 8 - len(committee)
+        if extra:
+            full[-1] &= 0xFF >> extra
+        chain.write_commit_sig(
+            block.block_num, b"\x01" * 96 + bytes(full)
+        )
+        pool.drop_applied()
+
+
+def plain_transfers(count: int, tag: int):
+    """Unsigned transfers + synthetic pre-recovered senders (the shape
+    admission sees after signature recovery — loadgen's flood shape)."""
+    from ..core.types import Transaction
+
+    out = []
+    per_sender = 16  # ACCOUNT_SLOTS: stay in the executable tier
+    n_senders = (count + per_sender - 1) // per_sender
+    for s in range(n_senders):
+        sender = bytes([0x4c, tag, s // 256, s % 256]) + b"\x00" * 16
+        for n in range(min(per_sender, count - s * per_sender)):
+            out.append((Transaction(
+                nonce=n, gas_price=1, gas_limit=21_000, shard_id=0,
+                to_shard=0, to=b"\x2d" * 20, value=1,
+            ), sender))
+    return out
+
+
+def pop_submissions(count: int, tag: int, seed: int):
+    """CREATE_VALIDATOR submissions whose BLS proofs-of-possession
+    verify on the scheduler's INGRESS lane (2 keys each)."""
+    from .. import bls as B
+    from ..core.types import Directive, StakingTransaction
+
+    out = []
+    for i in range(count):
+        group = i // 16
+        sender = bytes([0x50, tag, group // 256, group % 256]
+                       ) + b"\x00" * 16
+        bks = [
+            B.PrivateKey.generate(bytes([seed % 251, tag, i % 251, j]))
+            for j in range(2)
+        ]
+        out.append((StakingTransaction(
+            nonce=i % 16, gas_price=1, gas_limit=50_000,
+            directive=Directive.CREATE_VALIDATOR,
+            fields={
+                "amount": 10**20, "min_self_delegation": 10**18,
+                "bls_keys": b"".join(k.pub.bytes for k in bks),
+                "bls_key_sigs": b"".join(
+                    B.proof_of_possession(k) for k in bks
+                ),
+            },
+        ), sender))
+    return out

@@ -1,0 +1,811 @@
+"""Chaos scenario executor: build the topology, pour the traffic, run
+the fault script, check the invariants, dump evidence on violation.
+
+One :func:`run` call executes one :class:`~.scenario.Scenario` against
+an in-process localnet (threaded nodes over the InProcessNetwork hub,
+per-node sync servers + downloaders over real TCP streams, optional
+sidecar-backed engines) with the full production verification stack
+armed: forced device path (twin kernels unless
+``HARMONY_CHAOS_REAL_KERNELS=1``), the shared verification scheduler,
+round tracing + flight recorder, deterministic fault injection seeded
+from the scenario.
+
+Invariants are evaluated AFTER teardown over the run's own
+observability surfaces — tracer round spans (abandoned rounds
+excluded from latency quantiles), the scheduler's shed counters, the
+chains themselves for liveness and fork checks.  Every violation
+produces exactly ONE correlated flight-recorder dump: the violation
+kind is unique per (scenario, invariant) and carries the last round's
+trace id, so ``trace.anomaly``'s (kind, trace_id) dedup makes the
+"exactly one" machine-enforced, not convention.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import faultinject as FI
+from .. import trace
+from ..log import get_logger
+from .scenario import Scenario
+
+CHAIN_ID = 2
+_log = get_logger("chaostest")
+
+_SHED_REASONS = ("breaker_open", "queue_full", "deadline", "expired")
+
+
+def _consensus_sheds() -> float:
+    from ..sched.scheduler import SHED
+
+    return sum(
+        SHED.value(lane="consensus", reason=r) for r in _SHED_REASONS
+    )
+
+
+def _m(value, unit: str, **fields) -> dict:
+    out = {"value": value, "unit": unit, "source": "measured"}
+    out.update(fields)
+    return out
+
+
+def _quantiles(values: list) -> tuple:
+    if not values:
+        return None, None
+    s = sorted(values)
+    return (s[len(s) // 2], s[min(len(s) - 1, int(len(s) * 0.99))])
+
+
+@dataclass
+class NodeHandle:
+    name: str
+    shard: int
+    index: int
+    node: object = None
+    chain: object = None
+    pool: object = None
+    sync_server: object = None
+    sync_clients: list = field(default_factory=list)
+    sidecar_client: object = None
+    pump: object = None
+
+
+@dataclass
+class RunEnv:
+    """Everything a custom invariant (or the drive loop) can see."""
+
+    scenario: Scenario
+    net: object
+    handles: list
+    registry: object
+    ecdsa_keys: list
+    ext_keys: list
+    data: dict = field(default_factory=dict)  # scenario scratch (cx...)
+    round_durs: dict = field(default_factory=dict)
+    errors: list = field(default_factory=list)
+    sidecar_server: object = None
+
+    def by_shard(self, shard: int) -> list:
+        return [h for h in self.handles if h.shard == shard]
+
+    def shard_head(self, shard: int) -> int:
+        """Network head: max over the shard (a partitioned or lagging
+        node must not mask the committee's progress)."""
+        return max(
+            (h.node.chain.head_number for h in self.by_shard(shard)),
+            default=0,
+        )
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    passed: bool
+    violations: list
+    metrics: dict
+    violation_dumps: list
+    all_dumps: list
+    heads: dict
+
+
+# -- build -------------------------------------------------------------------
+
+
+def _build(scenario: Scenario, registry, built: list | None = None
+           ) -> RunEnv:
+    from ..chain.engine import Engine, EpochContext
+    from ..core.blockchain import Blockchain
+    from ..core.genesis import Genesis, dev_genesis
+    from ..core.kv import MemKV
+    from ..core.tx_pool import TxPool
+    from ..multibls import PrivateKeys
+    from ..node.node import Node
+    from ..node.registry import Registry
+    from ..p2p import InProcessNetwork
+    from ..p2p.stream import SyncClient, SyncServer
+    from ..sync import Downloader
+    from . import fixtures as FX
+
+    top = scenario.topology
+    spans = [2 if i < top.multikey else 1 for i in range(top.nodes)]
+    n_keys = sum(spans)
+    genesis0, ecdsa_keys, bls_keys = dev_genesis(
+        n_accounts=n_keys, n_keys=n_keys, shard_id=0
+    )
+    shard_genesis = {0: genesis0}
+    for s in range(1, top.shards):
+        shard_genesis[s] = Genesis(
+            config=genesis0.config, shard_id=s,
+            alloc=dict(genesis0.alloc),
+            committee=list(genesis0.committee),
+        )
+    ext_keys = [
+        FX.external_bls_key(scenario.seed, i)
+        for i in range(top.external_validators)
+    ]
+
+    env = RunEnv(
+        scenario=scenario, net=InProcessNetwork(), handles=[],
+        registry=registry, ecdsa_keys=ecdsa_keys, ext_keys=ext_keys,
+    )
+    if built is not None:
+        # expose the env to the caller BEFORE any resource (server
+        # socket, sidecar dial) is opened: a build that raises partway
+        # must still be tear-downable
+        built.append(env)
+
+    if top.sidecar:
+        from ..sidecar.server import SidecarServer
+
+        env.sidecar_server = SidecarServer().start()
+
+    # ONE EpochContext per distinct committee across every chain in
+    # the run (nodes + replay replicas): same-committee checks share a
+    # device-resident table and coalesce in the scheduler's buckets —
+    # the deployment shape (committee tables are per-epoch state)
+    ctx_cache: dict = {}
+    ctx_lock = threading.Lock()
+
+    def shared_ctx(committee: list) -> EpochContext:
+        key = tuple(committee)
+        with ctx_lock:
+            ctx = ctx_cache.get(key)
+            if ctx is None:
+                ctx = EpochContext(list(key))
+                ctx_cache[key] = ctx
+            return ctx
+
+    def mk_chain(shard: int):
+        """A full chain for ``shard``: trustless committee provider
+        (each chain answers epochs from ITS OWN persisted elections),
+        optional finalizer, optional sidecar-backed engine.  Returns
+        (chain, sidecar_client_or_None)."""
+        client = None
+        if env.sidecar_server is not None:
+            from ..sidecar.client import SidecarClient
+
+            client = SidecarClient(env.sidecar_server.address)
+        holder: dict = {}
+
+        def provider(s, epoch):
+            return shared_ctx(
+                holder["chain"].committee_for_epoch(epoch)
+            )
+
+        chain = Blockchain(
+            MemKV(), shard_genesis[shard],
+            engine=Engine(provider, device=True, backend=client),
+            blocks_per_epoch=top.blocks_per_epoch,
+            finalizer=(
+                FX.staking_finalizer(
+                    genesis0, ecdsa_keys, shard_count=top.shards
+                ) if top.staking else None
+            ),
+        )
+        holder["chain"] = chain
+        return chain, client
+
+    env.data["mk_chain"] = mk_chain
+
+    for s in range(top.shards):
+        for i in range(top.nodes):
+            # the handle registers BEFORE its resources are allocated:
+            # if any later step raises (port bind on a loaded box, a
+            # wedged sidecar dial), run()'s teardown still closes
+            # whatever this partial handle already owns
+            handle = NodeHandle(name=f"s{s}n{i}", shard=s, index=i)
+            env.handles.append(handle)
+            handle.chain, handle.sidecar_client = mk_chain(s)
+            handle.pool = TxPool(CHAIN_ID, s, handle.chain.state)
+            reg = Registry(
+                blockchain=handle.chain, txpool=handle.pool,
+                host=env.net.host(handle.name),
+            )
+            reg.set("metrics", registry)
+            if top.shards > 1:
+                reg.set("shard_count", top.shards)
+            key_index = sum(spans[:i])
+            keys = list(bls_keys[key_index:key_index + spans[i]])
+            if s == 0 and i < len(ext_keys):
+                # the external validator's key rides node i as an
+                # extra (multi-key) slot key: once the election seats
+                # it, the node votes with both
+                keys.append(ext_keys[i])
+            handle.sync_server = SyncServer(handle.chain)
+            handle.node = Node(reg, PrivateKeys.from_keys(keys))
+            handle._registry = reg
+
+    # sync mesh per shard: every node can pull from every other —
+    # consensus-timeout sync and post-heal rejoin both need a peer
+    for h in env.handles:
+        peers = [
+            p for p in env.by_shard(h.shard) if p is not h
+        ]
+        h.sync_clients = [
+            SyncClient(p.sync_server.port, timeout=5.0) for p in peers
+        ]
+        if h.sync_clients:
+            h._registry.set("downloader", Downloader(
+                h.chain, h.sync_clients, verify_seals=True,
+                request_deadline_s=2.0,
+            ))
+
+    # staking topologies: register the external validators up front so
+    # epoch 0's election block seats them (POPs verify on the INGRESS
+    # lane like any live registration)
+    for i, ext in enumerate(ext_keys):
+        stx = FX.external_validator_stake(
+            ecdsa_keys[i], ext, chain_id=CHAIN_ID
+        )
+        for h in env.by_shard(0):
+            try:
+                h.pool.add(stx, is_staking=True)
+            except Exception as e:  # noqa: BLE001 — a rejected stake
+                # breaks the scenario's premise: surface it
+                env.errors.append(f"stake submit {h.name}: {e!r}")
+    return env
+
+
+# -- traffic -----------------------------------------------------------------
+
+
+def _paced_flood(env: RunEnv, txs, rate: float, is_staking: bool,
+                 category: str, ready, stop, done: list):
+    from ..core.tx_pool import PoolError, TxPool
+
+    class _StubState:
+        def nonce(self, addr):
+            return 0
+
+        def balance(self, addr):
+            return 10**30
+
+    try:
+        pool = TxPool(CHAIN_ID, 0, _StubState, cap=len(txs) + 64)
+        ready.wait()
+        start = time.monotonic()
+        n = 0
+        for i, (tx, sender) in enumerate(txs):
+            if stop.is_set():
+                break
+            target = start + i / rate
+            now = time.monotonic()
+            if now < target:
+                time.sleep(min(target - now, 0.05))
+            try:
+                pool.add(tx, is_staking=is_staking, sender=sender)
+            except PoolError:
+                pass  # replacement/caps: still a submission
+            n += 1
+        done.append((category, n, time.monotonic() - start))
+    except Exception as e:  # noqa: BLE001 — fail the scenario loudly
+        env.errors.append(f"{category} flood: {e!r}")
+        done.append((category, 0, 0.0))
+
+
+def _replay_worker(env: RunEnv, stop):
+    """Re-verify the committed shard-0 chain into fresh replicas — the
+    SYNC-lane seal batches concurrent with live rounds (and, in the
+    staking topology, across the election boundary)."""
+    mk_chain = env.data["mk_chain"]
+    src = env.by_shard(0)[0].chain
+    try:
+        while not stop.is_set():
+            head = src.head_number
+            if head < 1:
+                time.sleep(0.01)
+                continue
+            replica, client = mk_chain(0)
+            try:
+                blocks, proofs = [], []
+                for n in range(1, head + 1):
+                    blk = src.block_by_number(n)
+                    proof = src.read_commit_sig(n)
+                    if blk is None or proof is None:
+                        break
+                    blocks.append(blk)
+                    proofs.append(proof)
+                if blocks:
+                    replica.insert_chain(blocks, commit_sigs=proofs,
+                                         verify_seals=True)
+            finally:
+                if client is not None:
+                    # per-iteration replica clients must not accumulate
+                    # sockets + reader threads across a long flap run
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+    except Exception as e:  # noqa: BLE001
+        env.errors.append(f"replay worker: {e!r}")
+
+
+def _cx_submitter(env: RunEnv, stop):
+    """Shard-0 -> shard-1 transfers from dev account 0, submitted into
+    every shard-0 pool once both shards are live; the arrival of the
+    credited balance on shard 1 is the scenario's custom invariant."""
+    from ..core.types import Transaction
+
+    n = env.scenario.traffic.cross_shard_transfers
+    sender_key = env.ecdsa_keys[0]
+    sender = sender_key.address()
+    dest = b"\x2c" * 20
+    env.data["cx_dest"] = dest
+    env.data["cx_expected"] = 0
+    try:
+        deadline = time.monotonic() + env.scenario.window_s
+        while time.monotonic() < deadline and not stop.is_set():
+            if env.shard_head(0) >= 1 and env.shard_head(1) >= 1:
+                break
+            time.sleep(0.05)
+        total = 0
+        for t in range(n):
+            if stop.is_set():
+                break
+            value = 1000 + t
+            tx = Transaction(
+                nonce=t, gas_price=1, gas_limit=30_000, shard_id=0,
+                to_shard=1, to=dest, value=value,
+            ).sign(sender_key, CHAIN_ID)
+            for h in env.by_shard(0):
+                try:
+                    h.pool.add(tx, sender=sender)
+                except Exception:  # noqa: BLE001 — pool dedup/caps
+                    pass
+            total += value
+            time.sleep(0.2)
+        env.data["cx_expected"] = total
+    except Exception as e:  # noqa: BLE001
+        env.errors.append(f"cx submitter: {e!r}")
+
+
+# -- the fault-script timeline -----------------------------------------------
+
+
+def _resolve_partition(env: RunEnv, spec: str) -> list:
+    """``"s0n1"`` literal; ``"leader[:shard]"`` whoever reports
+    is_leader at trigger time; ``"round_leader[:shard]"`` the holder of
+    the IN-FLIGHT round's leader slot (head view + 1) — the node whose
+    absence wedges the current round, forcing a real view change
+    (plain "leader" races the commit: with per-block rotation it can
+    name the PREVIOUS round's proposer, which nobody misses)."""
+    shard = int(spec.split(":")[1]) if ":" in spec else 0
+    hs = env.by_shard(shard)
+    if spec.startswith("round_leader"):
+        ref = hs[0].node
+        view = ref.chain.current_header().view_id + 1
+        key = ref.leader_key(view)
+        return [
+            h.name for h in hs
+            if any(k.pub.bytes == key for k in h.node.keys)
+        ]
+    if spec.startswith("leader"):
+        return [h.name for h in hs if h.node.is_leader]
+    return [spec]
+
+
+def _timeline(env: RunEnv, stop, t0: float, phases_done):
+    """Execute the scenario's fault script: trigger each phase on its
+    round/time condition, arm its faultinject rules with the window's
+    expiry, black-hole its partitions, heal at window end."""
+    pending = list(env.scenario.phases)
+    active: list = []  # (phase, end_monotonic_or_None, names)
+    try:
+        while not stop.is_set() and (pending or active):
+            now_s = time.monotonic() - t0
+            head = env.shard_head(0)
+            for phase in pending[:]:
+                hit = (
+                    (phase.at_s is not None and now_s >= phase.at_s)
+                    or (phase.at_round is not None
+                        and head >= phase.at_round)
+                )
+                if not hit:
+                    continue
+                pending.remove(phase)
+                names = []
+                for spec in phase.partition:
+                    names.extend(_resolve_partition(env, spec))
+                for nm in names:
+                    env.net.partitioned.add(nm)
+                for arm_kw in phase.arms:
+                    kw = dict(arm_kw)
+                    if phase.duration_s is not None:
+                        kw.setdefault("t1", phase.duration_s)
+                    FI.arm(**kw)
+                end = (None if phase.duration_s is None
+                       else time.monotonic() + phase.duration_s)
+                active.append((phase, end, names))
+                _log.warn(
+                    "chaos phase armed", phase=phase.name,
+                    at_round=head, t_s=round(now_s, 2),
+                    partitioned=",".join(names) or "-",
+                    arms=len(phase.arms),
+                )
+            for entry in active[:]:
+                phase, end, names = entry
+                if end is not None and time.monotonic() >= end:
+                    for nm in names:
+                        env.net.partitioned.discard(nm)
+                    active.remove(entry)
+                    _log.warn("chaos phase healed", phase=phase.name)
+            time.sleep(0.05)
+    finally:
+        # scenario end or abort: heal every partition we created
+        # (armed rules expire by their own t1 windows)
+        for _, _, names in active:
+            for nm in names:
+                env.net.partitioned.discard(nm)
+        phases_done.set()
+
+
+def _round_collector(env: RunEnv, stop):
+    """Poll the bounded tracer store for finished consensus.round
+    spans before they age out; abandoned rounds (view change / sync
+    rejoin) are excluded from the latency quantiles — they measure a
+    fault window, not a commit."""
+    def sweep():
+        for s in trace.spans():
+            if (s.name == "consensus.round" and s.dur_s is not None
+                    and not s.attrs.get("abandoned")):
+                env.round_durs[s.span_id] = s.dur_s
+    while not stop.is_set():
+        sweep()
+        time.sleep(0.25)
+    sweep()
+
+
+# -- invariants --------------------------------------------------------------
+
+
+def _last_round_trace(env: RunEnv):
+    last = None
+    for s in trace.spans():
+        if s.name == "consensus.round":
+            if last is None or s.t0 > last.t0:
+                last = s
+    return None if last is None else last.trace_id
+
+
+def _check_invariants(env: RunEnv, sheds: float) -> list:
+    inv = env.scenario.invariants
+    top = env.scenario.topology
+    violations = []
+
+    def violated(name: str, detail: str):
+        violations.append({"invariant": name, "detail": detail})
+
+    heads = {
+        s: [h.node.chain.head_number for h in env.by_shard(s)]
+        for s in range(top.shards)
+    }
+    if any(min(hs) < inv.min_blocks for hs in heads.values()):
+        violated(
+            "liveness",
+            f"heads {heads} below min_blocks={inv.min_blocks}",
+        )
+    if inv.zero_consensus_sheds and sheds > 0:
+        violated("zero_consensus_sheds",
+                 f"{sheds:g} consensus-lane sheds")
+    _, p99 = _quantiles(list(env.round_durs.values()))
+    if not env.round_durs:
+        violated("round_latency", "no committed round spans observed")
+    elif p99 > inv.round_p99_s:
+        violated(
+            "round_latency",
+            f"round p99 {p99:.3f}s > bound {inv.round_p99_s}s "
+            f"({len(env.round_durs)} rounds)",
+        )
+    if inv.no_divergent_heads:
+        for s in range(top.shards):
+            hs = env.by_shard(s)
+            common = min(h.node.chain.head_number for h in hs)
+            if common < 1:
+                continue
+            hashes = {
+                h.node.chain.block_by_number(common).hash()
+                for h in hs
+            }
+            if len(hashes) != 1:
+                violated(
+                    "no_divergent_heads",
+                    f"shard {s} forked at height {common}: "
+                    f"{len(hashes)} distinct blocks",
+                )
+    if inv.min_view_changes:
+        vcs = sum(h.node.new_views_adopted for h in env.handles)
+        if vcs < inv.min_view_changes:
+            violated(
+                "view_change_completed",
+                f"{vcs} NEWVIEW adoptions < {inv.min_view_changes} "
+                "(the storm never stormed or never recovered)",
+            )
+    if inv.min_epochs:
+        epochs = min(
+            h.node.chain.epoch_of(h.node.chain.head_number)
+            for h in env.by_shard(0)
+        )
+        if epochs < inv.min_epochs:
+            violated(
+                "epoch_boundary_crossed",
+                f"epoch {epochs} < required {inv.min_epochs}",
+            )
+    for name, fn in inv.custom:
+        try:
+            ok, detail = fn(env)
+        except Exception as e:  # noqa: BLE001 — a broken check IS a
+            # violation, not a crash of the sweep
+            ok, detail = False, f"invariant check raised: {e!r}"
+        if not ok:
+            violated(name, detail)
+    if env.errors:
+        violated("no_worker_errors", "; ".join(env.errors[:4]))
+    return violations
+
+
+# -- run ---------------------------------------------------------------------
+
+
+def run(scenario: Scenario, registry=None) -> ScenarioResult:
+    """Execute one scenario end to end; always tears the localnet down,
+    always evaluates invariants, never raises for a violation (the
+    result carries them — the sweep CLI turns them into exit codes)."""
+    from .. import device as DV
+    from .. import sched
+    from ..metrics import Registry as MetricsRegistry
+
+    registry = registry or MetricsRegistry()
+    prev_twin = os.environ.get("HARMONY_KERNEL_TWIN")
+    if os.environ.get("HARMONY_CHAOS_REAL_KERNELS") != "1":
+        # twin kernels: every device-path layer (tables, bitmaps,
+        # scheduler buckets, counters) without XLA pairing compiles
+        os.environ["HARMONY_KERNEL_TWIN"] = "1"
+
+    FI.reset()
+    FI.set_seed(scenario.seed)
+    sched.reset()
+    sched.configure(flush_window_s=0.01)
+    trace.reset()
+    trace.configure(
+        enabled=True,
+        dump_cooldown_s=2.0,  # distinct anomaly kinds per violation;
+        # the cooldown only throttles repeats of one kind
+    )
+    DV.use_device(True)
+    sheds_before = _consensus_sheds()
+    fi_points = ("device.dispatch", "sidecar.call", "sidecar.frame",
+                 "p2p.stream", "webhook.post")
+    hits_before = {p: FI.hits(p) for p in fi_points}
+
+    stop = threading.Event()
+    ready = threading.Event()
+    phases_done = threading.Event()
+    floods_done: list = []
+    env = None
+    built: list = []
+    threads: list = []
+    pumps: list = []
+    t0 = time.monotonic()
+    try:
+        env = _build(scenario, registry, built)
+        tr = scenario.traffic
+        flood_specs = []
+        if tr.plain_rate > 0:
+            count = int(tr.plain_rate * tr.flood_duration_s)
+            from . import fixtures as FX
+
+            flood_specs.append(
+                (FX.plain_transfers(count, 1), tr.plain_rate, False,
+                 "plain")
+            )
+        if tr.pop_rate > 0:
+            count = max(4, int(tr.pop_rate * tr.flood_duration_s))
+            from . import fixtures as FX
+
+            flood_specs.append(
+                (FX.pop_submissions(count, 2, scenario.seed),
+                 tr.pop_rate, True, "pop")
+            )
+        for spec in flood_specs:
+            threads.append(threading.Thread(
+                target=_paced_flood,
+                args=(env, *spec, ready, stop, floods_done),
+                daemon=True,
+            ))
+        for _ in range(tr.replay_workers):
+            threads.append(threading.Thread(
+                target=_replay_worker, args=(env, stop), daemon=True,
+            ))
+        if tr.cross_shard_transfers and scenario.topology.shards > 1:
+            threads.append(threading.Thread(
+                target=_cx_submitter, args=(env, stop), daemon=True,
+            ))
+        threads.append(threading.Thread(
+            target=_round_collector, args=(env, stop), daemon=True,
+        ))
+        # the timeline rides the same joined pool: it must be DOWN
+        # before teardown clears partitions and resets faultinject, or
+        # a racing phase trigger could re-arm rules into the next
+        # scenario of this process
+        timeline = threading.Thread(
+            target=_timeline, args=(env, stop, t0, phases_done),
+            daemon=True,
+        )
+        threads.append(timeline)
+
+        for t in threads:
+            t.start()
+        pumps = [
+            h.node.run_forever(
+                poll_interval=0.002,
+                block_time=scenario.topology.block_time_s,
+                phase_timeout=scenario.topology.phase_timeout_s,
+            )
+            for h in env.handles
+        ]
+        ready.set()
+
+        deadline = t0 + scenario.window_s
+        n_floods = len(flood_specs)
+
+        def customs_ok() -> bool:
+            # scenario-specific goals gate COMPLETION too: a cross-
+            # shard transfer still in flight (or an election not yet
+            # persisted) must keep the run open until the window
+            # expires — stopping at min_blocks alone flaked the
+            # cx_arrived invariant on timing
+            for _, fn in scenario.invariants.custom:
+                try:
+                    ok, _ = fn(env)
+                except Exception:  # noqa: BLE001 — not ready yet
+                    return False
+                if not ok:
+                    return False
+            return True
+
+        tick = 0
+        while time.monotonic() < deadline:
+            if env.errors:
+                break  # a dead worker: stop early, report as violation
+            heads_ok = all(
+                h.node.chain.head_number
+                >= scenario.invariants.min_blocks
+                for h in env.handles
+            )
+            tick += 1
+            if (heads_ok and phases_done.is_set()
+                    and len(floods_done) >= n_floods
+                    and tick % 5 == 0 and customs_ok()):
+                # customs polled every 5th tick: they read chain state
+                # (balances, persisted elections) and need no 20 Hz
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        # sample fault-point hit counters BEFORE the registry reset
+        fi_hits = {p: FI.hits(p) for p in fi_points}
+        if env is None and built:
+            env = built[0]  # _build raised partway: tear down what exists
+        if env is not None:
+            for t in threads:
+                t.join(timeout=30)
+            for h in env.handles:
+                if h.node is not None:
+                    h.node.stop()
+            for p in pumps:
+                p.join(timeout=10)
+            # heal any leftover partition before invariant checks
+            env.net.partitioned.clear()
+            for h in env.handles:
+                for c in h.sync_clients:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                if h.sync_server is not None:
+                    h.sync_server.close()
+                if h.sidecar_client is not None:
+                    try:
+                        h.sidecar_client.close()
+                    except OSError:
+                        pass
+            if env.sidecar_server is not None:
+                env.sidecar_server.stop()
+        FI.reset()
+        # stop the global scheduler flush thread too: a daemon thread
+        # parked in a native wait at interpreter exit is the classic
+        # "terminate called without an active exception" abort vector
+        # for the host process (pytest or the sweep CLI); the next
+        # scenario/caller re-creates it lazily
+        sched.reset()
+        DV.use_device(None)
+        if prev_twin is None:
+            os.environ.pop("HARMONY_KERNEL_TWIN", None)
+        else:
+            os.environ["HARMONY_KERNEL_TWIN"] = prev_twin
+
+    run_s = time.monotonic() - t0
+    sheds = _consensus_sheds() - sheds_before
+    violations = _check_invariants(env, sheds)
+
+    # evidence: exactly ONE correlated dump per violation — the kind
+    # is unique per (scenario, invariant) and carries the last round's
+    # trace, so trace.anomaly's dedup + cooldown make repeats no-ops
+    last_trace = _last_round_trace(env)
+    violation_dumps = []
+    for v in violations:
+        path = trace.anomaly(
+            f"chaos.{scenario.name}.{v['invariant']}",
+            trace_id=last_trace, detail=v["detail"],
+            scenario=scenario.name, seed=scenario.seed,
+        )
+        v["dump"] = path
+        if path:
+            violation_dumps.append(path)
+
+    p50, p99 = _quantiles(list(env.round_durs.values()))
+    heads = {
+        s: [h.node.chain.head_number for h in env.by_shard(s)]
+        for s in range(scenario.topology.shards)
+    }
+    faults_fired = sum(
+        fi_hits[p] - hits_before[p] for p in fi_points
+    )
+    metrics = {
+        "blocks_min": _m(
+            min(min(hs) for hs in heads.values()), "blocks",
+            floor=scenario.invariants.min_blocks,
+        ),
+        "round_p99_s": _m(
+            p99 and round(p99, 4), "s", bound=scenario.invariants.round_p99_s,
+            rounds=len(env.round_durs),
+            derived_from="tracer_spans",
+        ),
+        "round_p50_s": _m(
+            p50 and round(p50, 4), "s", rounds=len(env.round_durs),
+            derived_from="tracer_spans",
+        ),
+        "consensus_sheds": _m(sheds, "sheds"),
+        "view_changes": _m(
+            sum(h.node.view_changes for h in env.handles), "votes",
+        ),
+        "new_views_adopted": _m(
+            sum(h.node.new_views_adopted for h in env.handles),
+            "adoptions",
+        ),
+        "fault_point_hits": _m(faults_fired, "hits"),
+        "run_s": _m(round(run_s, 2), "s",
+                    window_s=scenario.window_s),
+    }
+    return ScenarioResult(
+        name=scenario.name,
+        passed=not violations,
+        violations=violations,
+        metrics=metrics,
+        violation_dumps=violation_dumps,
+        all_dumps=trace.dumps(),
+        heads=heads,
+    )

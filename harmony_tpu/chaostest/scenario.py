@@ -1,0 +1,107 @@
+"""Declarative chaos scenarios: topology × traffic × faults × invariants.
+
+ROADMAP item 5's vocabulary.  A :class:`Scenario` composes
+
+* a **topology** — how the in-process localnet is shaped: nodes per
+  shard, shards, multi-key validators, epoch length, whether a real
+  EPoS finalizer runs elections at the boundary, whether seal checks
+  go through a verification sidecar;
+* a **traffic profile** — the loadgen-style ingress/replay pressure
+  running concurrently with the rounds: paced plain-transfer floods
+  into tx-pool admission, staking submissions whose BLS
+  proofs-of-possession verify on the scheduler's INGRESS lane, replay
+  workers re-verifying the committed chain down the SYNC lane, and
+  cross-shard transfers;
+* a **fault script** — timed/round-triggered phases arming
+  ``faultinject`` rules (now window-capable: ``t0``/``t1``/``when``)
+  and partitioning nodes out of the gossip hub ("black-hole the
+  leader at round 3 for 10 s");
+* **invariants** — the machine-checked postconditions: liveness (the
+  chain advances ≥ N blocks inside the window), ZERO consensus-lane
+  sheds, a round-p99 bound, no divergent heads, plus scenario-specific
+  custom checks (committee rotated, cross-shard value arrived, ...).
+
+Everything here is data; ``runner.py`` executes it and ``scenarios.py``
+names the five roadmap scenarios.  Scenarios are seed-deterministic:
+keys, fixtures and garble bytes all derive from ``Scenario.seed``
+(wall-clock phase boundaries are scripted, so a run replays the same
+fault SCRIPT even though thread interleavings differ).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Shape of the in-process localnet."""
+
+    nodes: int = 4             # validators per shard
+    shards: int = 1
+    multikey: int = 0          # first M nodes hold TWO committee keys
+    blocks_per_epoch: int = 16
+    staking: bool = False      # wire a Finalizer: real EPoS elections
+    external_validators: int = 0  # staked external keys; key i rides
+    #                               node i as an extra (multi-key) key
+    sidecar: bool = False      # engines verify seals via a sidecar
+    block_time_s: float = 0.25
+    phase_timeout_s: float = 8.0  # consensus timeout -> view change
+
+
+@dataclass(frozen=True)
+class Traffic:
+    """Concurrent load riding the scheduler lanes during the run."""
+
+    plain_rate: float = 0.0    # paced tx/s into tx-pool admission
+    pop_rate: float = 0.0      # staking BLS-POP submissions/s (INGRESS)
+    replay_workers: int = 0    # chain re-verification loops (SYNC)
+    cross_shard_transfers: int = 0  # shard-0 -> shard-1 transfers
+    flood_duration_s: float = 6.0   # how long the paced floods run
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One scripted fault window.
+
+    Triggered when the shard-0 network head reaches ``at_round`` OR
+    ``at_s`` seconds elapse (whichever is given); lasts ``duration_s``
+    (None = until scenario end).  ``arms`` are ``faultinject.arm``
+    kwargs dicts — armed at trigger time with ``t1=duration_s`` so the
+    rules expire with the window.  ``partition`` names nodes to
+    black-hole out of the gossip hub for the window: literal host
+    names (``"s0n1"``), ``"leader"`` (shard 0's leader at trigger
+    time) or ``"leader:<shard>"``; they are healed when the window
+    closes."""
+
+    name: str
+    at_round: int | None = None
+    at_s: float | None = None
+    duration_s: float | None = None
+    arms: tuple = ()
+    partition: tuple = ()
+
+
+@dataclass(frozen=True)
+class Invariants:
+    """Machine-checked postconditions; every violation is a finding
+    AND one correlated flight-recorder dump."""
+
+    min_blocks: int = 2          # every node of every shard reaches this
+    round_p99_s: float = 30.0    # committed-round p99 bound (tracer)
+    zero_consensus_sheds: bool = True
+    no_divergent_heads: bool = True
+    min_view_changes: int = 0    # a storm scenario must actually storm
+    min_epochs: int = 0          # election scenario must cross epochs
+    custom: tuple = ()           # (name, fn(env) -> (ok, detail)) pairs
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    seed: int
+    topology: Topology = field(default_factory=Topology)
+    traffic: Traffic = field(default_factory=Traffic)
+    phases: tuple = ()
+    invariants: Invariants = field(default_factory=Invariants)
+    window_s: float = 90.0       # hard wall for the whole run

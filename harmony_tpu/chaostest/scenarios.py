@@ -1,0 +1,245 @@
+"""The five named adversarial scenarios (ROADMAP item 5).
+
+Each builder returns a :class:`~.scenario.Scenario`; ``quick=True``
+scales durations/targets down to the check.sh stage budget while
+keeping every structural ingredient — the same topology shape, the
+same fault script, the same invariants.  ``SCENARIOS`` is the sweep
+registry (``tools/chaos_sweep.py`` iterates it).
+
+Scenario × fault × invariant rationale lives in docs/ANALYSIS.md
+("Scenario matrix").
+"""
+
+from __future__ import annotations
+
+from .scenario import Invariants, Phase, Scenario, Topology, Traffic
+
+
+def _committee_rotated(env):
+    """Election scenario: epoch 1's committee must differ from genesis
+    and seat the staked external key."""
+    chain = env.by_shard(0)[0].chain
+    com1 = chain.committee_for_epoch(1)
+    genesis_com = list(chain.genesis.committee)
+    ext = env.ext_keys[0].pub.bytes if env.ext_keys else None
+    if com1 == genesis_com:
+        return False, "epoch-1 committee identical to genesis"
+    if ext is not None and ext not in com1:
+        return False, "staked external key missing from epoch-1 committee"
+    return True, ""
+
+
+def _cx_arrived(env):
+    """Cross-shard scenario: the transferred value must be credited on
+    shard 1 despite the partition window."""
+    expected = env.data.get("cx_expected", 0)
+    dest = env.data.get("cx_dest")
+    if not expected or dest is None:
+        return False, "no cross-shard transfers were submitted"
+    best = max(
+        h.node.chain.state().balance(dest) for h in env.by_shard(1)
+    )
+    if best < expected:
+        return False, (
+            f"shard-1 credit {best} < transferred {expected}"
+        )
+    return True, ""
+
+
+def view_change_storm(quick: bool = False) -> Scenario:
+    """Leader black-holed mid-round under an ingress flood: the
+    committee must view-change to a live leader, keep committing, and
+    the healed ex-leader must resync and rejoin."""
+    return Scenario(
+        name="view_change_storm",
+        seed=11,
+        topology=Topology(
+            nodes=4, block_time_s=0.2,
+            phase_timeout_s=2.0 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=250.0 if quick else 800.0,
+            pop_rate=8.0, replay_workers=1,
+            flood_duration_s=5.0 if quick else 12.0,
+        ),
+        phases=(
+            Phase(
+                "blackhole-leader", at_round=2,
+                duration_s=6.0 if quick else 12.0,
+                partition=("round_leader",),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=25.0,
+            min_view_changes=1,
+        ),
+        window_s=90.0 if quick else 180.0,
+    )
+
+
+def epoch_election_rotation(quick: bool = False) -> Scenario:
+    """Epoch-boundary EPoS election + committee rotation (a staked
+    external key joins a multi-key node) while replay saturates the
+    SYNC lane, POP floods the INGRESS lane and the device backend
+    flaps across the boundary."""
+    return Scenario(
+        name="epoch_election_rotation",
+        seed=13,
+        topology=Topology(
+            nodes=4, staking=True, external_validators=1,
+            blocks_per_epoch=4, block_time_s=0.2,
+            phase_timeout_s=6.0 if quick else 9.0,
+        ),
+        traffic=Traffic(
+            plain_rate=150.0 if quick else 500.0,
+            pop_rate=12.0, replay_workers=2,
+            flood_duration_s=6.0 if quick else 12.0,
+        ),
+        phases=(
+            Phase(
+                "device-flap-at-election", at_round=3,
+                duration_s=4.0 if quick else 8.0,
+                arms=(
+                    {"point": "device.dispatch",
+                     "exc": RuntimeError, "every": 3},
+                ),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=9 if quick else 13,
+            round_p99_s=30.0,
+            min_epochs=2 if quick else 3,
+            custom=(("committee_rotated", _committee_rotated),),
+        ),
+        window_s=110.0 if quick else 220.0,
+    )
+
+
+def cross_shard_partition(quick: bool = False) -> Scenario:
+    """Cross-shard receipt traffic while a destination-shard validator
+    is partitioned and sync streams flap: the transfer must still land
+    (leader-side export retries + destination CXPool dedup), both
+    shards stay live, nobody forks."""
+    return Scenario(
+        name="cross_shard_partition",
+        seed=17,
+        topology=Topology(
+            nodes=4, shards=2, block_time_s=0.4,
+            phase_timeout_s=4.0 if quick else 6.0,
+        ),
+        traffic=Traffic(
+            plain_rate=80.0 if quick else 300.0,
+            replay_workers=1,
+            cross_shard_transfers=2 if quick else 5,
+            flood_duration_s=5.0 if quick else 10.0,
+        ),
+        phases=(
+            Phase(
+                "partition-dest-validator", at_round=2,
+                duration_s=4.0 if quick else 8.0,
+                partition=("s1n1",),
+                arms=(
+                    {"point": "p2p.stream",
+                     "exc": ConnectionResetError, "every": 5},
+                ),
+            ),
+        ),
+        # the SHARP invariants here are cx_arrived (the transfer must
+        # be included on shard 1 — ongoing destination liveness) and
+        # no_divergent_heads; the block floor is deliberately modest
+        # because 8 nodes + the source shard's churn share one vCPU
+        # and a destination VC recovery can straddle the window tail
+        invariants=Invariants(
+            min_blocks=3 if quick else 6,
+            round_p99_s=90.0,
+            custom=(("cx_arrived", _cx_arrived),),
+        ),
+        window_s=150.0 if quick else 260.0,
+    )
+
+
+def validator_churn(quick: bool = False) -> Scenario:
+    """Rolling connectivity churn across a committee with multi-key
+    operators (6 keys over 4 nodes): single-slot validators drop out
+    and return in sequence; the chain keeps committing at the quorum
+    edge (5-of-6) and every returned node converges on one history."""
+    return Scenario(
+        name="validator_churn",
+        seed=19,
+        topology=Topology(
+            nodes=4, multikey=2, block_time_s=0.25,
+            phase_timeout_s=3.0 if quick else 5.0,
+        ),
+        traffic=Traffic(
+            plain_rate=150.0 if quick else 400.0,
+            pop_rate=6.0, replay_workers=1,
+            flood_duration_s=5.0 if quick else 10.0,
+        ),
+        phases=(
+            Phase(
+                "churn-out-n3", at_round=1,
+                duration_s=3.0 if quick else 6.0,
+                partition=("s0n3",),
+            ),
+            Phase(
+                "churn-out-n2", at_round=3,
+                duration_s=3.0 if quick else 6.0,
+                partition=("s0n2",),
+                arms=(
+                    {"point": "device.dispatch",
+                     "exc": ConnectionResetError, "every": 4},
+                ),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=5 if quick else 9,
+            round_p99_s=25.0,
+        ),
+        window_s=100.0 if quick else 200.0,
+    )
+
+
+def sidecar_flap(quick: bool = False) -> Scenario:
+    """Sidecar-backed seal verification flapping during quorum
+    assembly: slow calls and injected stream desyncs force reconnect +
+    committee replay mid-round while replay traffic rides the same
+    sidecar — rounds must keep finalizing with zero consensus sheds."""
+    return Scenario(
+        name="sidecar_flap",
+        seed=23,
+        topology=Topology(
+            nodes=4, sidecar=True, block_time_s=0.25,
+            phase_timeout_s=5.0 if quick else 8.0,
+        ),
+        traffic=Traffic(
+            pop_rate=8.0, replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        phases=(
+            Phase(
+                "sidecar-flap", at_round=1,
+                duration_s=6.0 if quick else 12.0,
+                arms=(
+                    {"point": "sidecar.call",
+                     "delay_s": 0.05, "every": 2},
+                    {"point": "sidecar.frame",
+                     "exc": ValueError, "every": 9, "times": 2},
+                ),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=30.0,
+        ),
+        window_s=100.0 if quick else 200.0,
+    )
+
+
+SCENARIOS = {
+    "view_change_storm": view_change_storm,
+    "epoch_election_rotation": epoch_election_rotation,
+    "cross_shard_partition": cross_shard_partition,
+    "validator_churn": validator_churn,
+    "sidecar_flap": sidecar_flap,
+}

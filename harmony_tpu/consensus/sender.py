@@ -59,3 +59,14 @@ class MessageSender:
                 if num <= committed_block_num:
                     cancel.set()
                     del self._active[msg_type]
+
+    def stop_all(self):
+        """Cancel EVERY retry loop (node shutdown): a stopped node must
+        leave no thread re-publishing into the network — retry threads
+        outliving the node by their ~70 s budget kept running gossip
+        and native hashing into interpreter teardown (shutdown aborts
+        in the chaos suite)."""
+        with self._lock:
+            for _, cancel in self._active.values():
+                cancel.set()
+            self._active.clear()

@@ -526,12 +526,43 @@ class Blockchain:
         blocks = [b for b, _ in pairs]
         commit_sigs = [s for _, s in pairs]
 
-        blocks, proofs = self._resolve_and_verify(
-            blocks, commit_sigs, self.current_header(), verify_seals,
-            lane,
-        )
+        # pre-resolve carried proofs over the FULL window (blocks[i+1]
+        # holds blocks[i]'s proof) so the epoch segmentation below
+        # can't lose the proof of a segment's last block
+        commit_sigs = list(commit_sigs)
+        for i in range(len(blocks) - 1):
+            nxt = blocks[i + 1].header
+            if commit_sigs[i] is None and nxt.last_commit_sig:
+                commit_sigs[i] = (
+                    nxt.last_commit_sig + nxt.last_commit_bitmap
+                )
 
-        # execution + persistence pass
+        # a replay window crossing an election boundary must verify in
+        # SEGMENTS: the blocks after an election block (non-empty
+        # header.shard_state) are sealed by the committee that election
+        # seats, which this chain only learns by EXECUTING the election
+        # block.  One up-front batch verified them against the stale
+        # committee and rejected every honest post-boundary block (the
+        # chaos sweep's election scenario found this — replay across
+        # epoch 0 -> 1 failed with "bad commit signature").  Same
+        # segmentation as insert_headers_fast.
+        inserted = 0
+        parent = self.current_header()
+        start = 0
+        for i, block in enumerate(blocks):
+            if not (i == len(blocks) - 1 or block.header.shard_state):
+                continue
+            seg, seg_proofs = self._resolve_and_verify(
+                blocks[start:i + 1], commit_sigs[start:i + 1],
+                parent, verify_seals, lane,
+            )
+            inserted += self._execute_segment(seg, seg_proofs)
+            parent = block.header
+            start = i + 1
+        return inserted
+
+    def _execute_segment(self, blocks, proofs):
+        """Execution + persistence pass over verified blocks."""
         inserted = 0
         for block, proof in zip(blocks, proofs):
             spent_keys = self.verify_incoming_receipts(block)

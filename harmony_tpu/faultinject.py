@@ -9,6 +9,18 @@ deterministically: matching is pure counting (``after``/``every``/
 ``garble`` mutates bytes via sha256 of the registry seed — no clocks,
 no ``random`` — so a chaos run replays bit-for-bit.
 
+Scenario scripts (chaostest) additionally arm *phased* rules: a rule
+with ``t0``/``t1`` is only live inside that wall-clock window
+(seconds relative to the ``arm()`` call), and a rule with ``when=``
+is only live while the predicate returns True — e.g.
+``when=lambda: 3 <= chain.head_number < 6`` scripts "fault between
+round 3 and round 6" instead of counting hits.  Hits outside a rule's
+live window do not consume its ``after``/``every``/``times`` budget,
+so "black-hole the backend from t=5s for 10s" composes with counting
+rules on the same point.  ``when`` runs under the registry lock on
+the injected hot path: keep it to a cheap read (an int attribute, an
+event flag) and never call back into this module from it.
+
 Wired injection points:
 
     device.dispatch  — device.py, before each verify/agg/batch program
@@ -34,9 +46,10 @@ class FaultInjected(ConnectionError):
 
 class _Rule:
     __slots__ = ("exc", "delay_s", "garble", "key", "every", "times",
-                 "after", "seen", "fired")
+                 "after", "seen", "fired", "t0", "t1", "when")
 
-    def __init__(self, exc, delay_s, garble, key, every, times, after):
+    def __init__(self, exc, delay_s, garble, key, every, times, after,
+                 t0=None, t1=None, when=None):
         self.exc = exc
         self.delay_s = delay_s
         self.garble = garble
@@ -44,11 +57,30 @@ class _Rule:
         self.every = max(1, every)
         self.times = times
         self.after = max(0, after)
-        self.seen = 0  # matching hits observed
+        self.seen = 0  # matching hits observed (while live)
         self.fired = 0  # faults actually delivered
+        self.t0 = t0  # absolute monotonic window start (None = open)
+        self.t1 = t1  # absolute monotonic window end (None = open)
+        self.when = when  # predicate gating liveness (None = always)
 
     def matches(self, key) -> bool:
         return self.key is None or self.key == key
+
+    def live(self, now: float) -> bool:
+        """Is this rule's phase window open?  Outside it the rule is
+        invisible: no counting, no firing."""
+        if self.t0 is not None and now < self.t0:
+            return False
+        if self.t1 is not None and now >= self.t1:
+            return False
+        if self.when is not None:
+            try:
+                if not self.when():
+                    return False
+            except Exception:  # noqa: BLE001 — a broken predicate must
+                # never fault the production call site it gates
+                return False
+        return True
 
     def take(self) -> bool:
         """Count one matching hit; True if this hit should fault."""
@@ -89,7 +121,9 @@ def set_seed(seed: int) -> None:
 
 def arm(point: str, *, exc=None, delay_s: float | None = None,
         garble: bool = False, key=None, every: int = 1,
-        times: int | None = None, after: int = 0) -> None:
+        times: int | None = None, after: int = 0,
+        t0: float | None = None, t1: float | None = None,
+        when=None) -> None:
     """Arm a fault at ``point``.
 
     exc      exception class/instance/factory to raise (default
@@ -101,13 +135,24 @@ def arm(point: str, *, exc=None, delay_s: float | None = None,
     every    fault every Nth matching hit (1 = all)
     times    stop after this many delivered faults (None = forever)
     after    skip the first N matching hits
+    t0/t1    phased mode: the rule is live only between t0 and t1
+             seconds AFTER this arm() call (None = unbounded on that
+             side); hits outside the window are not counted
+    when     phased mode: the rule is live only while this zero-arg
+             predicate returns True (e.g. a round-window closure over
+             ``chain.head_number``); must be cheap and must not call
+             back into faultinject — it runs under the registry lock
     """
     global _armed
     if exc is None and delay_s is None and not garble:
         exc = FaultInjected
+    now = time.monotonic()
     with _lock:
         _rules.setdefault(point, []).append(
-            _Rule(exc, delay_s, garble, key, every, times, after)
+            _Rule(exc, delay_s, garble, key, every, times, after,
+                  t0=None if t0 is None else now + t0,
+                  t1=None if t1 is None else now + t1,
+                  when=when)
         )
         _armed = True
 
@@ -132,11 +177,14 @@ def fire(point: str, key=None) -> None:
     if not _armed:
         return
     delay_s, exc = None, None
+    now = time.monotonic()
     with _lock:
         _hits[point] = _hits.get(point, 0) + 1
         for rule in _rules.get(point, ()):
             if rule.garble or not rule.matches(key):
                 continue  # garble rules spend their budget in garble()
+            if not rule.live(now):
+                continue  # outside its phase window: invisible
             if rule.take():
                 delay_s, exc = rule.delay_s, rule.exc
                 break
@@ -153,11 +201,14 @@ def garble(point: str, data: bytes, key=None) -> bytes:
     if not _armed or not data:
         return data
     hit = False
+    now = time.monotonic()
     with _lock:
         _hits[point] = _hits.get(point, 0) + 1
         for rule in _rules.get(point, ()):
             if not rule.garble or not rule.matches(key):
                 continue  # fire-style rules spend their budget in fire()
+            if not rule.live(now):
+                continue  # outside its phase window: invisible
             if rule.take():
                 hit = True
                 break

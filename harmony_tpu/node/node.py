@@ -68,6 +68,7 @@ class Node:
         self.chain = registry.blockchain
         self.pool = registry.txpool
         self.keys = keys
+        self._round_keys = keys  # per-round committee subset (_new_round)
         self.network = network
         self.policy = policy
         self.roster = roster
@@ -79,6 +80,8 @@ class Node:
         self._stop = threading.Event()
         self.committed_blocks = 0
         self.dropped_messages = 0
+        self.view_changes = 0  # view-change votes this node started
+        self.new_views_adopted = 0  # NEWVIEW adoptions (chaos metrics)
         self.webhooks = registry.get("webhooks")
         self.pending_double_signs: list = []  # evidence for proposals
         self._vc = 0  # view changes since last commit
@@ -183,7 +186,7 @@ class Node:
     @property
     def is_leader(self) -> bool:
         return any(
-            k.pub.bytes == self._round_leader_key for k in self.keys
+            k.pub.bytes == self._round_leader_key for k in self._round_keys
         )
 
     # -- round lifecycle ----------------------------------------------------
@@ -205,6 +208,28 @@ class Node:
         # plus its local view-change count (reset on commit)
         self.view_id = head.view_id + 1 + self._vc
         committee = self.committee()
+        # only keys holding a slot in THIS round's committee may sign:
+        # a multi-key operator whose extra key is not (or no longer)
+        # elected would otherwise aggregate a non-committee signature
+        # into every vote and have ALL its votes rejected — exactly
+        # what the epoch-rotation and churn chaos scenarios hit.  A
+        # node with no elected key this epoch runs as an observer:
+        # it validates and commits but never votes.  When this node
+        # holds the round's LEADER slot, that key goes FIRST: every
+        # receiver binds messages to sender_pubkeys[0], so a multi-key
+        # leader whose rotation landed on its second key must lead
+        # with it (the chaos sweep's election scenario wedged on
+        # exactly this — validators dropped every post-election
+        # announce as "not this view's leader").
+        self._round_leader_key = self.leader_key(self.view_id)
+        cset = set(committee)
+        eligible = [k for k in self.keys if k.pub.bytes in cset]
+        self._round_keys = PrivateKeys.from_keys(
+            [k for k in eligible
+             if k.pub.bytes == self._round_leader_key]
+            + [k for k in eligible
+               if k.pub.bytes != self._round_leader_key]
+        )
         cfg = RoundConfig(
             committee=committee,
             block_num=self.block_num,
@@ -214,13 +239,12 @@ class Node:
             ),
         )
         decider = Decider(self.policy, committee, self.roster)
-        self.leader = Leader(self.keys, cfg, decider)
-        self.validator = Validator(self.keys, cfg, decider)
+        self.leader = Leader(self._round_keys, cfg, decider)
+        self.validator = Validator(self._round_keys, cfg, decider)
         self._proposed = False
         self._sent_prepared = False
         self._sent_committed = False
         self._pending_block = None  # validator's decoded announce block
-        self._round_leader_key = self.leader_key(self.view_id)
         self._round_start = time.monotonic()
         self.in_view_change = False
         self._vc_collector = None
@@ -310,11 +334,22 @@ class Node:
             # computation over a past epoch seed finishes)
             vrf = b""
             epoch = self.chain.epoch_of(self.block_num)
-            if self.chain.config.is_active("vrf", epoch) and len(self.keys):
+            if self.chain.config.is_active("vrf", epoch) and len(
+                self._round_keys
+            ):
                 from .. import crypto_vrf
 
+                # sign with the key that IS this view's leader slot —
+                # a multi-key node's first key need not be the one the
+                # rotation landed on, and validators verify against
+                # _round_leader_key
+                vrf_key = next(
+                    (k for k in self._round_keys
+                     if k.pub.bytes == self._round_leader_key),
+                    self._round_keys[0],
+                )
                 _out, proof = crypto_vrf.evaluate(
-                    self.keys[0], self.chain.current_header().hash()
+                    vrf_key, self.chain.current_header().hash()
                 )
                 vrf = proof
             incoming = self.cx_pool.drain() if self.cx_pool else None
@@ -582,6 +617,8 @@ class Node:
         # commit payloads bind the block header's own view (differs from
         # the round view only for a view-change re-proposal)
         self.validator.cfg.payload_view_id = block.header.view_id
+        if not self._round_keys:
+            return  # observer this epoch: follow, never vote
         vote = self.validator.on_announce(msg)
         self._broadcast(vote)
         self.log.info(
@@ -704,6 +741,8 @@ class Node:
     def _on_prepared(self, msg: FBFTMessage):
         if self.is_leader:
             return
+        if not self._round_keys:
+            return  # observer: cannot cast a commit vote
         vote = self.validator.on_prepared(msg)
         if vote is not None:
             # remember the prepared proof: a view change must carry it
@@ -852,11 +891,22 @@ class Node:
 
     # -- view change (reference: consensus/view_change.go:220-553) ----------
 
+    def vc_timeout(self) -> float:
+        """The CURRENT consensus timeout: the base phase timeout for a
+        live round, GROWING with each failed view change (reference:
+        view_change.go getTimeout — viewChangeDuration scales with the
+        view distance).  Constant timeouts never converge: validators
+        whose timers drifted keep voting for DIFFERENT views, so no
+        single view ever assembles M3 quorum — the churn chaos
+        scenario stormed for a hundred seconds on exactly that."""
+        return self.phase_timeout * min(1 + self._vc, 8)
+
     def start_view_change(self):
         """Phase timeout: vote to move to the next view (startViewChange).
         Carries the prepared proof (M1) when this node saw PREPARED —
         the half-done block must survive into the new view."""
         self._vc += 1
+        self.view_changes += 1
         head = self.chain.current_header()
         new_view = head.view_id + 1 + self._vc
         self.in_view_change = True
@@ -872,11 +922,14 @@ class Node:
                       if self._round_span is not None else None),
             block=self.block_num, new_view=new_view,
         )
+        self._round_start = time.monotonic()
+        if not self._round_keys:
+            return  # observer: adopt whatever NEWVIEW quorum emerges
         prepared_hash = None
         if self._prepared_proof is not None and self._pending_block is not None:
             prepared_hash = self._pending_block.hash()
         vc = construct_viewchange(
-            self.keys, new_view, self.block_num,
+            self._round_keys, new_view, self.block_num,
             prepared_hash, self._prepared_proof,
         )
         msg = sign_message(FBFTMessage(
@@ -884,15 +937,15 @@ class Node:
             view_id=new_view,
             block_num=self.block_num,
             block_hash=prepared_hash or bytes(32),
-            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            sender_pubkeys=[k.pub.bytes for k in self._round_keys],
             payload=encode_viewchange(vc),
             block=self._prepared_block_bytes if prepared_hash else b"",
-        ), self.keys)
-        self._round_start = time.monotonic()
+        ), self._round_keys)
         # the view's designated leader collects VC votes — start my
         # collector (and self-vote) if that's me
         if any(
-            k.pub.bytes == self.leader_key(new_view) for k in self.keys
+            k.pub.bytes == self.leader_key(new_view)
+            for k in self._round_keys
         ):
             committee = self.committee()
             self._vc_collector = ViewChangeCollector(
@@ -902,11 +955,20 @@ class Node:
             self._vc_collector.on_viewchange(vc)
             if prepared_hash:
                 self._vc_block_bytes = self._prepared_block_bytes
-            # votes that arrived before our own timeout
+            # votes that arrived before our own timeout.  Draining can
+            # reach quorum MID-LOOP: _on_viewchange_msg then emits
+            # NEWVIEW and adopts the view, and _new_round clears the
+            # collector — stop draining and don't re-try on the dead
+            # collector (a multi-key next leader whose own keys plus
+            # the early votes already meet quorum hit this every time;
+            # the crash killed the consensus pump thread)
             pending, self._vc_pending = self._vc_pending, []
             for early in pending:
                 self._on_viewchange_msg(early)
-            self._try_new_view(new_view)
+                if self._vc_collector is None:
+                    break  # quorum reached: new view already adopted
+            if self._vc_collector is not None:
+                self._try_new_view(new_view)
         self._broadcast(msg, retry=True)
 
     def _on_viewchange_msg(self, msg: FBFTMessage):
@@ -932,7 +994,17 @@ class Node:
         self._try_new_view(msg.view_id)
 
     def _try_new_view(self, new_view: int):
-        nv = self._vc_collector.try_new_view(self.block_num, self.keys)
+        if self._vc_collector is None:
+            return  # already adopted (or never this node's collection)
+        # the NEW view's leader slot key must lead the sender list —
+        # receivers bind NEWVIEW to sender_pubkeys[0] (a multi-key
+        # collector's first round key need not be the new view's slot)
+        nv_leader = self.leader_key(new_view)
+        keys = PrivateKeys.from_keys(
+            [k for k in self._round_keys if k.pub.bytes == nv_leader]
+            + [k for k in self._round_keys if k.pub.bytes != nv_leader]
+        )
+        nv = self._vc_collector.try_new_view(self.block_num, keys)
         if nv is None:
             return
         block_bytes = (
@@ -944,10 +1016,10 @@ class Node:
             block_num=self.block_num,
             block_hash=(nv.m1_payload[:32] if nv.m1_payload
                         else bytes(32)),
-            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            sender_pubkeys=[k.pub.bytes for k in keys],
             payload=encode_newview(nv),
             block=block_bytes,
-        ), self.keys)
+        ), keys)
         self._broadcast(out, retry=True)
         self._adopt_new_view(new_view, nv, block_bytes)
 
@@ -984,6 +1056,7 @@ class Node:
         the carried prepared block, or proposes fresh."""
         head = self.chain.current_header()
         self._vc = max(new_view - head.view_id - 1, 0)
+        self.new_views_adopted += 1
         self.log.info(
             "adopt new view", new_view=new_view, block=self.block_num,
             carried_block=bool(nv.m1_payload),
@@ -1021,29 +1094,41 @@ class Node:
 
         def loop():
             while not self._stop.is_set():
-                now = time.monotonic()
-                if now - self._last_propose >= block_time:
-                    self.start_round_if_leader()
-                if (
-                    now - self._round_start > self.phase_timeout
-                    and self.chain.head_number + 1 == self.block_num
-                ):
-                    # fires again while ALREADY in view change: each
-                    # timeout escalates to the next view/leader (the
-                    # reference restarts VC with growing timeouts — a
-                    # dead next-leader must not wedge the network)
-                    self.start_view_change()
-                    if self._vc >= 2:
-                        # two VC timeouts without a commit: either the
-                        # network is dead (sync is a no-op) or it moved
-                        # on without us — e.g. we missed COMMITTED for a
-                        # round we prepared.  Probing peers' heads does
-                        # not depend on gossip reaching us, so this
-                        # recovers wedges the _ahead_runs counter can't
-                        # see (the reference's consensus-timeout sync,
-                        # consensus/downloader.go + view change spin)
-                        self._spin_up_sync()
-                if not self.process_pending():
+                try:
+                    now = time.monotonic()
+                    if now - self._last_propose >= block_time:
+                        self.start_round_if_leader()
+                    if (
+                        now - self._round_start > self.vc_timeout()
+                        and self.chain.head_number + 1 == self.block_num
+                    ):
+                        # fires again while ALREADY in view change: each
+                        # timeout escalates to the next view/leader (the
+                        # reference restarts VC with growing timeouts — a
+                        # dead next-leader must not wedge the network)
+                        self.start_view_change()
+                        if self._vc >= 2:
+                            # two VC timeouts without a commit: either
+                            # the network is dead (sync is a no-op) or it
+                            # moved on without us — e.g. we missed
+                            # COMMITTED for a round we prepared.  Probing
+                            # peers' heads does not depend on gossip
+                            # reaching us, so this recovers wedges the
+                            # _ahead_runs counter can't see (the
+                            # reference's consensus-timeout sync,
+                            # consensus/downloader.go + view change spin)
+                            self._spin_up_sync()
+                    busy = self.process_pending()
+                except Exception as e:  # noqa: BLE001 — the pump is the
+                    # node's heartbeat: one failed proposal or handler
+                    # must degrade to a logged skipped beat (the round
+                    # recovers via view change / sync), never silently
+                    # kill consensus on this node forever.  The chaos
+                    # sweep found exactly that: a crashed pump turns one
+                    # transient fault into a permanent outage.
+                    self.log.error("consensus pump error", err=repr(e))
+                    busy = 0
+                if not busy:
                     self._stop.wait(poll_interval)
 
         t = threading.Thread(target=loop, daemon=True)
@@ -1052,3 +1137,4 @@ class Node:
 
     def stop(self):
         self._stop.set()
+        self.sender.stop_all()  # no retry thread outlives the node

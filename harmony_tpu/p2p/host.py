@@ -143,7 +143,10 @@ class Host:
         self.name = name
         self._handlers: dict[str, list] = {}
         self._validators: dict[str, list] = {}
-        self._seen = _SeenCache()
+        # NOTE: message dedup (_SeenCache) lives on TCPHost only — the
+        # in-process hub is single-hop, so every delivery is already
+        # exactly-once per publish and re-publishes are deliberately
+        # fresh messages (the consensus sender's retry semantics)
         self._lock = threading.Lock()
 
     # -- subscription API (reference: host.go:66-71) ------------------------
@@ -212,11 +215,16 @@ class InProcessNetwork:
             return
         with self._lock:
             hosts = list(self._hosts)
+        # no dedup on the hub: it is single-hop (each publish visits
+        # each host exactly once, no multipath to suppress), and
+        # content-hash dedup here marked REJECTED messages seen
+        # FOREVER — the consensus sender's retry re-publishes (the
+        # mechanism that recovers a transiently IGNOREd NEWVIEW) were
+        # dead on arrival for ~50 s until cache eviction.  libp2p ids
+        # are (sender, seqno): every publish is a fresh message —
+        # TCPHost stamps the same semantics into its PUBLISH bodies.
         for h in hosts:
             if h.name == frm or h.name in self.partitioned:
-                continue
-            mid = keccak256(topic.encode() + payload)
-            if h._seen.seen(mid):
                 continue
             if h._validate(topic, payload, frm) == ACCEPT:
                 h._deliver(topic, payload, frm)
@@ -301,6 +309,15 @@ class TCPHost(Host):
         self._peer_topics: dict[object, set | None] = {}
         self._graft_backoff: dict[tuple, float] = {}  # (sockid,topic)->t
         self._mcache = _MsgCache()
+        self._seen = _SeenCache()  # flood-dedup: TCP re-floods multipath
+        # per-publish id salt+counter (stamped into PUBLISH bodies by
+        # _pack_publish; salt makes ids unique ACROSS hosts publishing
+        # identical payloads)
+        import os as _os
+
+        self._pub_salt = _os.urandom(4)
+        self._pub_seq = 0
+        self._pub_seq_lock = threading.Lock()
         self._iwant_asked: dict[bytes, float] = {}  # mid -> asked-at
         self.sent_publish_frames = 0  # egress accounting (tests/metrics)
         self.sent_ihave_frames = 0
@@ -481,10 +498,22 @@ class TCPHost(Host):
 
     # -- gossip -------------------------------------------------------------
 
-    @staticmethod
-    def _pack_publish(topic: str, payload: bytes) -> bytes:
+    def _pack_publish(self, topic: str, payload: bytes) -> bytes:
+        """[8B publish id][u8 tlen][topic][payload].  The publish id
+        (4B per-host salt + 4B counter) is stamped at ORIGIN and rides
+        the body through every re-flood, so the derived message id
+        keccak256(body) stays identical network-wide (loop prevention
+        intact) while a RE-PUBLISH of the same payload — the consensus
+        sender's retry, the mechanism that recovers a transiently
+        IGNOREd NEWVIEW — gets a fresh id instead of dying forever in
+        every peer's seen-cache (libp2p's (sender, seqno) message-id
+        semantics; the in-process hub got the same fix)."""
         t = topic.encode()
-        return bytes([len(t)]) + t + payload
+        with self._pub_seq_lock:
+            self._pub_seq += 1
+            seq = self._pub_seq
+        return (self._pub_salt + (seq & 0xFFFFFFFF).to_bytes(4, "big")
+                + bytes([len(t)]) + t + payload)
 
     def _on_publish(self, body: bytes, src_sock, frm: str, ip: str):
         # keyed on CONNECTION identity, like the scores: a spoofed
@@ -515,9 +544,10 @@ class TCPHost(Host):
             except queue.Empty:
                 continue
             try:
-                tlen = body[0]
-                topic = body[1:1 + tlen].decode()
-                payload = body[1 + tlen:]
+                # [8B publish id][u8 tlen][topic][payload]
+                tlen = body[8]
+                topic = body[9:9 + tlen].decode()
+                payload = body[9 + tlen:]
                 verdict = self._validate(topic, payload, frm)
             except Exception:  # noqa: BLE001 — malformed frame
                 verdict = REJECT

@@ -89,21 +89,31 @@ _dump_total = 0  # lifetime dump count; filenames rotate modulo the cap
 _dump_last: dict = {}  # kind -> monotonic time of its last dump
 _dump_cooldown_s = 30.0  # per-kind rate limit (a flapping breaker or
 # repeated view changes must not flood the disk or the trigger path)
+_dump_seen: "deque[tuple]" = deque(maxlen=1024)  # (kind, trace_id)
+# pairs already dumped: one anomaly trigger per trace writes ONE dump
+# — a view-change storm re-firing on the same wedged round must not
+# burn the disk budget re-snapshotting the same evidence
+_DUMP_BUDGET_DEFAULT = 64 * 1024 * 1024
+_dump_budget_bytes = _DUMP_BUDGET_DEFAULT  # lifetime byte cap; 0 = off
+_dump_bytes = 0  # payload bytes written since reset()
 
 
 def configure(enabled: bool | None = None, sample_rate: float | None = None,
               round_slo_s: float | None = ...,
               dump_dir: str | None = None,
-              dump_cooldown_s: float | None = None) -> None:
+              dump_cooldown_s: float | None = None,
+              dump_max_bytes: int | None = None) -> None:
     """Arm/tune the tracer.  ``sample_rate`` applies at ROOT span
     creation (deterministic by trace-id hash — no ``random``);
     ``round_slo_s`` arms the round-latency anomaly (``...`` = leave
     unchanged, ``None`` = disarm); ``dump_dir`` is where the flight
     recorder writes (default: $HARMONY_TPU_TRACE_DIR or
     <tmp>/harmony_tpu_flight); ``dump_cooldown_s`` rate-limits dumps
-    per anomaly kind (0 disables the limit)."""
+    per anomaly kind (0 disables the limit); ``dump_max_bytes`` caps
+    the lifetime bytes the flight recorder may write per process
+    (default 64 MiB; 0 disables the budget)."""
     global _enabled, _sample_rate, _round_slo_s, _dump_dir
-    global _dump_cooldown_s
+    global _dump_cooldown_s, _dump_budget_bytes
     if sample_rate is not None:
         _sample_rate = max(0.0, min(1.0, float(sample_rate)))
     if round_slo_s is not ...:
@@ -112,6 +122,8 @@ def configure(enabled: bool | None = None, sample_rate: float | None = None,
         _dump_dir = dump_dir
     if dump_cooldown_s is not None:
         _dump_cooldown_s = float(dump_cooldown_s)
+    if dump_max_bytes is not None:
+        _dump_budget_bytes = int(dump_max_bytes)
     if enabled is not None:
         _enabled = bool(enabled)
 
@@ -128,7 +140,7 @@ def reset() -> None:
     """Disarm and drop every buffer (test teardown).  Dump FILES are
     left on disk — they are the evidence a failed test points at."""
     global _enabled, _sample_rate, _round_slo_s, _dump_dir
-    global _dump_cooldown_s, _dump_total
+    global _dump_cooldown_s, _dump_total, _dump_budget_bytes, _dump_bytes
     _enabled = False
     _sample_rate = 1.0
     _round_slo_s = None
@@ -141,7 +153,10 @@ def reset() -> None:
     with _dump_lock:
         _dumps.clear()
         _dump_last.clear()
+        _dump_seen.clear()
         _dump_total = 0
+        _dump_budget_bytes = _DUMP_BUDGET_DEFAULT
+        _dump_bytes = 0
 
 
 def _new_id(nbytes: int) -> str:
@@ -467,12 +482,15 @@ def anomaly(kind: str, trace_id: str | None = None, **info) -> str | None:
     start (node.py), sidecar stream desync (sidecar/client.py), round
     SLO overrun (node.py).
 
-    Bounded by construction: dumps of one ``kind`` are rate-limited
-    (``dump_cooldown_s``; a flapping breaker cycling open must not
-    flood the trigger path or the disk) and file names rotate modulo
-    ``_DUMP_CAP``, so a process writes at most that many dump files.
-    Never raises into the trigger site — the triggers sit on the
-    consensus/device fallback paths."""
+    Bounded by construction, three ways: a (kind, trace_id) pair dumps
+    at most ONCE per process (a view-change storm re-triggering on the
+    same wedged round re-snapshots nothing), dumps of one ``kind`` are
+    rate-limited (``dump_cooldown_s``; a flapping breaker cycling open
+    must not flood the trigger path or the disk), and total payload
+    bytes are capped by ``dump_max_bytes`` (file names additionally
+    rotate modulo ``_DUMP_CAP``) — so an anomaly storm can never blow
+    out $HARMONY_TPU_TRACE_DIR.  Never raises into the trigger site —
+    the triggers sit on the consensus/device fallback paths."""
     if not _enabled:
         return None
     try:
@@ -484,19 +502,25 @@ def anomaly(kind: str, trace_id: str | None = None, **info) -> str | None:
 
 
 def _dump_anomaly(kind: str, trace_id: str | None, info: dict):
-    global _dump_total
+    global _dump_total, _dump_bytes
+    if trace_id is None:
+        sp = _current.get()
+        trace_id = sp.trace_id if sp is not None else None
     now = time.monotonic()
     with _dump_lock:
+        if trace_id is not None and (kind, trace_id) in _dump_seen:
+            return None  # this trigger already snapshotted this trace
         last = _dump_last.get(kind)
         if (_dump_cooldown_s > 0 and last is not None
                 and now - last < _dump_cooldown_s):
             return None  # this kind dumped recently: suppressed
+        if _dump_budget_bytes and _dump_bytes >= _dump_budget_bytes:
+            return None  # disk budget spent: suppressed
         _dump_last[kind] = now
+        if trace_id is not None:
+            _dump_seen.append((kind, trace_id))
         _dump_total += 1
         seq = _dump_total % _DUMP_CAP  # on-disk rotation
-    if trace_id is None:
-        sp = _current.get()
-        trace_id = sp.trace_id if sp is not None else None
     snap_spans = [s.to_dict() for s in spans(trace_id)]
     if trace_id is None:
         logs = list(_events)
@@ -514,13 +538,27 @@ def _dump_anomaly(kind: str, trace_id: str | None, info: dict):
                  or os.path.join(tempfile.gettempdir(),
                                  "harmony_tpu_flight"))
     path = os.path.join(directory, f"flight_{_PID}_{seq:04d}.json")
+    data = json.dumps(payload, separators=(",", ":"), default=str)
     try:
         os.makedirs(directory, exist_ok=True)
         with open(path, "w") as f:
-            json.dump(payload, f, separators=(",", ":"), default=str)
+            f.write(data)
+        with _dump_lock:
+            _dump_bytes += len(data)
     except OSError:
         path = None  # unwritable dump dir: the log line below is the
         # fallback record — never raise into the trigger site
+        with _dump_lock:
+            # roll back the dedup/cooldown reservation: a dump that
+            # never reached disk must not suppress the NEXT trigger of
+            # the same anomaly once the disk recovers (the dedup entry
+            # is permanent, unlike the old 30 s cooldown)
+            try:
+                _dump_seen.remove((kind, trace_id))
+            except ValueError:
+                pass
+            if _dump_last.get(kind) == now:
+                del _dump_last[kind]
     if path is not None:
         with _dump_lock:
             if path in _dumps:

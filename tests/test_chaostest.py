@@ -1,0 +1,268 @@
+"""Chaos-scenario framework tier: faultinject phase windows, flight-
+recorder dump capping, the scenario registry, violation evidence, the
+view-change quorum-mid-drain regression, and THE acceptance scenario
+(leader black-holed under flood -> view change -> recovery)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from harmony_tpu import faultinject as FI
+from harmony_tpu import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FI.reset()
+    trace.reset()
+    yield
+    FI.reset()
+    trace.reset()
+
+
+# -- faultinject: timed/phased arm mode --------------------------------------
+
+
+def test_fault_window_t0_t1():
+    """A rule with a [t0, t1) window fires only inside it, and hits
+    outside the window don't consume its counting budget.  Margins are
+    wide on the SIDE a scheduler stall could flip: pre-t0 fires happen
+    microseconds after arm (t0=0.3s away), the in-window fire happens
+    with ~10s of t1 headroom, and the closed-window case uses its own
+    already-expired rule."""
+    FI.arm("w.point", exc=RuntimeError, t0=0.3, t1=10.0, times=1)
+    FI.fire("w.point")  # before t0: invisible (would have fired times=1)
+    time.sleep(0.35)
+    with pytest.raises(RuntimeError):
+        FI.fire("w.point")
+    FI.reset()
+    FI.arm("w.closed", exc=RuntimeError, t1=0.05)
+    time.sleep(0.1)
+    FI.fire("w.closed")  # window closed: no fault
+
+
+def test_fault_window_budget_not_consumed_outside():
+    """after= counts only live hits: pre-window traffic must not eat
+    the skip budget."""
+    FI.arm("w.budget", exc=ValueError, t0=0.3, after=1)
+    for _ in range(5):
+        FI.fire("w.budget")  # pre-window: not counted
+    time.sleep(0.35)
+    FI.fire("w.budget")  # first LIVE hit: skipped by after=1
+    with pytest.raises(ValueError):
+        FI.fire("w.budget")
+
+
+def test_fault_when_predicate_round_window():
+    """when= gates liveness on a cheap predicate — the 'between round
+    k and k+m' scripting mode."""
+    head = {"n": 0}
+    FI.arm("w.round", exc=ConnectionError,
+           when=lambda: 3 <= head["n"] < 5)
+    for n in (0, 1, 2):
+        head["n"] = n
+        FI.fire("w.round")
+    head["n"] = 3
+    with pytest.raises(ConnectionError):
+        FI.fire("w.round")
+    head["n"] = 5
+    FI.fire("w.round")  # window closed
+
+
+def test_fault_when_predicate_error_is_safe():
+    """A broken predicate must never fault the production call site."""
+    FI.arm("w.broken", exc=RuntimeError,
+           when=lambda: (_ for _ in ()).throw(ValueError))
+    FI.fire("w.broken")  # predicate raised -> rule invisible
+
+
+# -- trace: flight-recorder dump capping -------------------------------------
+
+
+def test_anomaly_dedup_by_kind_and_trace(tmp_path):
+    """One (kind, trace_id) pair dumps at most once; a different trace
+    id of the same kind still dumps (cooldown disabled)."""
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=0)
+    p1 = trace.anomaly("storm", trace_id="a" * 32)
+    assert p1 is not None and os.path.exists(p1)
+    assert trace.anomaly("storm", trace_id="a" * 32) is None  # dedup
+    p2 = trace.anomaly("storm", trace_id="b" * 32)
+    assert p2 is not None and p2 != p1
+    # a different kind on the already-dumped trace is fresh evidence
+    assert trace.anomaly("desync", trace_id="a" * 32) is not None
+
+
+def test_anomaly_disk_budget(tmp_path):
+    """Once the byte budget is spent no further dumps are written;
+    reset() restores the default budget."""
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=0, dump_max_bytes=1)
+    p1 = trace.anomaly("k1", trace_id="c" * 32)
+    assert p1 is not None  # budget checked before the first write
+    assert trace.anomaly("k2", trace_id="d" * 32) is None  # spent
+    assert trace.anomaly("k3", trace_id="e" * 32) is None
+    trace.reset()
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=0)
+    assert trace.anomaly("k4", trace_id="f" * 32) is not None
+
+
+def test_anomaly_failed_write_does_not_burn_dedup(tmp_path):
+    """A dump that never reached disk (unwritable dir) must not mark
+    its (kind, trace_id) seen: the next trigger after the disk
+    recovers still writes the evidence."""
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("file where a directory must go")
+    trace.configure(enabled=True, dump_dir=str(blocker),
+                    dump_cooldown_s=0)
+    assert trace.anomaly("diskfail", trace_id="a" * 32) is None
+    trace.configure(dump_dir=str(tmp_path))
+    p = trace.anomaly("diskfail", trace_id="a" * 32)
+    assert p is not None and os.path.exists(p)
+
+
+def test_anomaly_cooldown_still_applies(tmp_path):
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=60.0)
+    assert trace.anomaly("cool", trace_id="1" * 32) is not None
+    # new trace id, same kind, inside the cooldown: suppressed
+    assert trace.anomaly("cool", trace_id="2" * 32) is None
+
+
+# -- scenario registry -------------------------------------------------------
+
+
+def test_scenario_registry_names_and_shape():
+    from harmony_tpu.chaostest import SCENARIOS
+
+    assert set(SCENARIOS) == {
+        "view_change_storm", "epoch_election_rotation",
+        "cross_shard_partition", "validator_churn", "sidecar_flap",
+    }
+    for name, builder in SCENARIOS.items():
+        for quick in (False, True):
+            s = builder(quick=quick)
+            assert s.name == name
+            assert s.invariants.min_blocks >= 1
+            assert s.invariants.round_p99_s > 0
+            assert s.topology.nodes >= 3
+            assert s.window_s > 0
+        # quick runs must genuinely be scaled down
+        assert (builder(quick=True).window_s
+                <= builder(quick=False).window_s)
+
+
+# -- the view-change quorum-mid-drain regression -----------------------------
+
+
+def test_view_change_quorum_mid_drain_does_not_crash(monkeypatch):
+    """Regression (found by the election scenario): a multi-key next
+    leader draining early-buffered VC votes reaches M3 quorum mid-loop;
+    adoption clears the collector, and the trailing try_new_view used
+    to crash the consensus pump with AttributeError on None."""
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    from harmony_tpu import bls as B
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import Genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+
+    from harmony_tpu.core.genesis import dev_genesis
+
+    keys = [B.PrivateKey.generate(bytes([140 + i])) for i in range(5)]
+    committee = [k.pub.bytes for k in keys]
+    base, _, _ = dev_genesis(n_keys=5)
+    genesis = Genesis(
+        config=base.config, shard_id=0, alloc=dict(base.alloc),
+        committee=committee,
+    )
+    net = InProcessNetwork()
+    nodes = []
+    key_sets = [[keys[0], keys[4]], [keys[1]], [keys[2]], [keys[3]]]
+    for i, ks in enumerate(key_sets):
+        chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        pool = TxPool(2, 0, chain.state)
+        reg = Registry(blockchain=chain, txpool=pool,
+                       host=net.host(f"n{i}"))
+        nodes.append(Node(reg, PrivateKeys.from_keys(ks)))
+
+    # next view's leader: view 2 -> committee[2 % 5] ... force the
+    # multi-key node to be the collector by picking the view whose
+    # slot is one of ITS keys.  view 5 -> committee[0] (node 0), and
+    # node 0 also holds committee[4]: quorum 4-of-5 is reachable from
+    # 3 early votes + its own 2 keys DURING the drain.
+    for n in nodes:
+        n._vc = 3  # next start_view_change votes for view 5
+    # validators time out first: their votes buffer at node 0
+    for n in nodes[1:]:
+        n.start_view_change()
+    for _ in range(50):
+        if not any(n.process_pending() for n in nodes):
+            break
+    # node 0's own timeout: drain hits quorum mid-loop.  Before the
+    # fix this raised AttributeError and killed the pump thread.
+    nodes[0]._vc = 3
+    nodes[0].start_view_change()
+    for _ in range(50):
+        if not any(n.process_pending() for n in nodes):
+            break
+    assert nodes[0].new_views_adopted >= 1
+    # every node that saw the NEWVIEW adopted the view (block_num 1)
+    adopted = sum(n.new_views_adopted for n in nodes)
+    assert adopted >= 3
+
+
+# -- violation evidence: exactly one dump per violation ----------------------
+
+
+def test_violation_produces_exactly_one_dump(tmp_path, monkeypatch):
+    """A scenario that cannot meet liveness must report the violation
+    AND exactly one correlated flight-recorder dump for it."""
+    monkeypatch.setenv("HARMONY_TPU_TRACE_DIR", str(tmp_path))
+    from harmony_tpu.chaostest import (
+        Invariants, Scenario, Topology, Traffic, run,
+    )
+
+    scenario = Scenario(
+        name="impossible_liveness",
+        seed=7,
+        topology=Topology(nodes=4, block_time_s=0.2,
+                          phase_timeout_s=30.0),
+        traffic=Traffic(),
+        invariants=Invariants(min_blocks=10_000, round_p99_s=60.0),
+        window_s=6.0,
+    )
+    r = run(scenario)
+    assert not r.passed
+    assert [v["invariant"] for v in r.violations] == ["liveness"]
+    assert len(r.violation_dumps) == 1
+    dump = json.load(open(r.violation_dumps[0]))
+    assert dump["kind"] == "chaos.impossible_liveness.liveness"
+    assert "min_blocks=10000" in dump["info"]["detail"]
+
+
+# -- THE acceptance scenario -------------------------------------------------
+
+
+def test_view_change_storm_scenario_passes(tmp_path, monkeypatch):
+    """Leader black-holed mid-round under flood: the committee view-
+    changes to a live leader, keeps committing with ZERO consensus
+    sheds, and the healed ex-leader resyncs — the chaos stack's
+    acceptance gate, tier-1 resident so regressions surface before the
+    full sweep stage."""
+    monkeypatch.setenv("HARMONY_TPU_TRACE_DIR", str(tmp_path))
+    from harmony_tpu.chaostest import run, scenarios
+
+    r = run(scenarios.view_change_storm(quick=True))
+    assert r.passed, f"violations: {r.violations}"
+    assert r.metrics["consensus_sheds"]["value"] == 0
+    assert r.metrics["new_views_adopted"]["value"] >= 1
+    assert r.metrics["blocks_min"]["value"] >= 4
+    assert not r.violation_dumps

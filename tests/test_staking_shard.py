@@ -79,3 +79,47 @@ def test_committee_assignment_round_robin_and_shard_by_key():
     # all 4 winners present across shards
     ext = [s for c in state.shards for s in c.slots if s.effective_stake]
     assert len(ext) == 4
+
+
+def test_committee_rotation_at_epoch_boundary():
+    """Full rotation arc on a real chain, via the SAME chaostest
+    fixtures the election-under-load scenario composes: a staked
+    external key (with BLS proof-of-possession) wins an epoch-0 slot,
+    the epoch-1 committee rotates to include it, and — because it keeps
+    signing — the epoch-2 election keeps it seated."""
+    from harmony_tpu.chaostest import fixtures as FX
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+
+    genesis, ecdsa_keys, _ = dev_genesis(n_accounts=4, n_keys=4)
+    chain = Blockchain(
+        MemKV(), genesis, blocks_per_epoch=4,
+        finalizer=FX.staking_finalizer(genesis, ecdsa_keys),
+    )
+    pool = TxPool(2, 0, chain.state)
+    ext = FX.external_bls_key(99, 0)
+    pool.add(
+        FX.external_validator_stake(ecdsa_keys[0], ext),
+        is_staking=True,
+    )
+
+    # epoch 0: blocks 1..3; block 3 is the election block
+    FX.advance_with_full_bitmaps(chain, pool, 3)
+    assert chain.is_election_block(3)
+    com1 = chain.committee_for_epoch(1)
+    assert len(com1) == 5 and ext.pub.bytes in com1
+    assert com1 != list(genesis.committee)  # it ROTATED
+    assert chain.committee_for_epoch(0) == list(genesis.committee)
+
+    # the boundary crossing itself: the first epoch-1 blocks commit
+    # under the rotated committee's full bitmaps
+    FX.advance_with_full_bitmaps(chain, pool, 3)
+    assert chain.head_number == 6
+    assert chain.epoch_of(chain.head_number) == 1
+
+    # the epoch-1 election (block 7) re-seats the signing validator
+    FX.advance_with_full_bitmaps(chain, pool, 2)
+    com2 = chain.committee_for_epoch(2)
+    assert ext.pub.bytes in com2 and len(com2) == 5

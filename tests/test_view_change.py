@@ -1,8 +1,12 @@
-"""View-change protocol tests: M1/M2/M3 collection, NEWVIEW, next leader."""
+"""View-change protocol tests: M1/M2/M3 collection, NEWVIEW, next
+leader — including the adversarial-delivery tier (message loss,
+duplicates, stale views, deterministic wire garbling) the chaos
+scenarios exercise at network scale."""
 
 import pytest
 
 from harmony_tpu import bls as B
+from harmony_tpu import faultinject as FI
 from harmony_tpu.consensus import view_change as VC
 from harmony_tpu.consensus.messages import encode_sig_and_bitmap
 from harmony_tpu.consensus.quorum import Decider, Phase, Policy
@@ -151,3 +155,164 @@ def test_tampered_m3_rejected(committee):
     nv = coll.try_new_view(block_num=8, leader_keys=keysets[0])
     nv.view_id += 1  # signature no longer matches the claimed view
     assert not VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+# -- adversarial delivery: loss, duplication, staleness, garbling ------------
+
+
+def test_message_loss_below_quorum_no_new_view(committee):
+    """Only 2 of 4 view-change votes arrive (uniform quorum needs 3):
+    no NEWVIEW may form, and the collector stays consistent for the
+    votes that DID land."""
+    keysets, keys = committee
+    view_id = 21
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    for ks in keysets[:2]:
+        assert coll.on_viewchange(
+            VC.construct_viewchange(ks, view_id, 10)
+        )
+    assert coll.try_new_view(block_num=10, leader_keys=keysets[0]) is None
+    assert len(coll.m3_sigs) == 2
+
+
+def test_message_loss_at_quorum_still_forms_new_view(committee):
+    """3 of 4 votes (one lost forever) is exactly quorum: the NEWVIEW
+    must form and verify — a single silent validator cannot stall the
+    view change."""
+    keysets, keys = committee
+    view_id = 23
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    for ks in keysets[:3]:
+        assert coll.on_viewchange(
+            VC.construct_viewchange(ks, view_id, 11)
+        )
+    nv = coll.try_new_view(block_num=11, leader_keys=keysets[1])
+    assert nv is not None
+    assert VC.verify_new_view(nv, keys, Decider(Policy.UNIFORM, keys))
+
+
+def test_duplicate_votes_are_idempotent(committee):
+    """Gossip redelivers the same vote (retry paths re-publish): the
+    second copy must change NOTHING — no double-counted quorum power,
+    no double-aggregated signature."""
+    keysets, keys = committee
+    view_id = 25
+    decider = Decider(Policy.UNIFORM, keys)
+    coll = VC.ViewChangeCollector(keys, decider, view_id)
+    msg = VC.construct_viewchange(keysets[0], view_id, 12)
+    assert coll.on_viewchange(msg)
+    before = (dict(coll.m3_sigs), decider.count(Phase.VIEWCHANGE))
+    for _ in range(3):
+        assert not coll.on_viewchange(msg)  # duplicate rejected
+    assert coll.m3_sigs == before[0]
+    assert decider.count(Phase.VIEWCHANGE) == before[1]
+
+
+def test_stale_and_future_view_votes_rejected(committee):
+    """Votes for any view other than the collector's (older rounds
+    replayed, or a peer that escalated further) leave no trace."""
+    keysets, keys = committee
+    view_id = 27
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    assert not coll.on_viewchange(
+        VC.construct_viewchange(keysets[0], view_id - 1, 13)
+    )
+    assert not coll.on_viewchange(
+        VC.construct_viewchange(keysets[0], view_id + 3, 13)
+    )
+    assert not coll.m3_sigs and not coll.m2_sigs
+
+
+def test_garbled_wire_bytes_never_crash_or_pollute(committee):
+    """Seed-deterministic wire corruption (the faultinject garble
+    engine) over encoded view-change messages: every corrupted variant
+    must either fail decode with ValueError or be rejected by the
+    collector — never crash, never leave partial state."""
+    keysets, keys = committee
+    view_id = 29
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    wire = VC.encode_viewchange(
+        VC.construct_viewchange(keysets[0], view_id, 14)
+    )
+    FI.reset()
+    try:
+        for seed in range(8):
+            FI.set_seed(seed)
+            FI.arm("vc.wire", garble=True)
+            bad = FI.garble("vc.wire", wire)
+            FI.reset()
+            assert bad != wire  # the garble engine really corrupted it
+            try:
+                msg = VC.decode_viewchange(bad)
+            except ValueError:
+                continue  # truncation/length forgery: failed fast
+            coll.on_viewchange(msg)  # must not raise
+        assert not coll.m3_sigs and not coll.m2_sigs  # nothing leaked
+        # the pristine original still lands afterwards
+        assert coll.on_viewchange(VC.decode_viewchange(wire))
+    finally:
+        FI.reset()
+
+
+def test_garbled_newview_rejected_by_verify(committee):
+    """A garbled NEWVIEW that still decodes must fail verification —
+    validators must not adopt a corrupted quorum proof."""
+    keysets, keys = committee
+    view_id = 31
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    for ks in keysets:
+        coll.on_viewchange(VC.construct_viewchange(ks, view_id, 15))
+    nv = coll.try_new_view(block_num=15, leader_keys=keysets[0])
+    wire = VC.encode_newview(nv)
+    FI.reset()
+    try:
+        rejected = 0
+        for seed in range(8):
+            FI.set_seed(seed)
+            FI.arm("nv.wire", garble=True)
+            bad = FI.garble("nv.wire", wire)
+            FI.reset()
+            try:
+                got = VC.decode_newview(bad)
+            except ValueError:
+                rejected += 1
+                continue
+            if not VC.verify_new_view(
+                got, keys, Decider(Policy.UNIFORM, keys)
+            ):
+                rejected += 1
+        assert rejected == 8  # every corruption caught
+    finally:
+        FI.reset()
+
+
+def test_conflicting_prepared_payloads_rejected(committee):
+    """Two voters claiming DIFFERENT prepared blocks: the second
+    conflicting claim is rejected outright (one round can only have
+    prepared one block)."""
+    keysets, keys = committee
+    view_id = 33
+    coll = VC.ViewChangeCollector(
+        keys, Decider(Policy.UNIFORM, keys), view_id
+    )
+    hash_a = bytes(range(32))
+    hash_b = bytes(reversed(range(32)))
+    proof_a = _real_prepared_proof(keysets, keys, hash_a)
+    proof_b = _real_prepared_proof(keysets, keys, hash_b)
+    assert coll.on_viewchange(
+        VC.construct_viewchange(keysets[0], view_id, 16, hash_a, proof_a)
+    )
+    assert not coll.on_viewchange(
+        VC.construct_viewchange(keysets[1], view_id, 16, hash_b, proof_b)
+    )
+    assert coll.m1_payload == VC.m1_payload(hash_a, proof_a)

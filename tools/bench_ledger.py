@@ -41,7 +41,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Direction of goodness by metric-name shape.  Metrics matching no
 # pattern are diffed but never flagged (unknown direction).
 _UP_PATTERNS = ("_per_sec", "_per_s", "pairings_per_s", "pairs_per_sec",
-                "fill_ratio", "tx_per_s")
+                "fill_ratio", "tx_per_s", "_passed", "blocks_min")
 _DOWN_PATTERNS = ("_ms", "_seconds", "_s_", "p50", "p99", "latency")
 
 # Bookkeeping values that are parameters, not performance metrics.

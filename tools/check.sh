@@ -38,6 +38,18 @@
 #      consensus sheds) and tools/bench_ledger.py --check over the
 #      committed BENCH_r*.json rounds (machine-readable regression
 #      flags; measurement redefinitions are exempt).
+#   7. chaos sweep — the composed adversarial tier: the chaostest
+#      framework unit tests, then tools/chaos_sweep.py --quick
+#      --check runs all five named scenarios (leader black-holed
+#      under flood, epoch-boundary election under saturated lanes,
+#      cross-shard traffic under partition, validator churn at the
+#      quorum edge, sidecar flapping during quorum assembly) and
+#      asserts the liveness + zero-consensus-shed + round-p99 +
+#      no-divergent-heads invariants; the sweep's FRESH metrics are
+#      written as an ephemeral BENCH round and bench_ledger --check
+#      gates them against the committed history (wide 80% threshold:
+#      composed-scenario latencies jitter more than kernel benches
+#      on this box).
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -80,5 +92,16 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   tests/test_bench_ledger.py
 JAX_PLATFORMS=cpu python tools/loadgen.py --duration 5 --check
 python tools/bench_ledger.py --check > /dev/null
+
+echo "== chaos sweep: composed adversarial scenarios =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_chaostest.py
+CHAOS_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND"' EXIT
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --bench-out "$CHAOS_ROUND" --bench-round 999 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$CHAOS_ROUND" > /dev/null
 
 echo "check.sh: OK"
