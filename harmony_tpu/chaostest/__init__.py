@@ -4,22 +4,24 @@ Public surface:
 
 * :mod:`.scenario` — the declarative vocabulary (Topology, Traffic,
   Phase, Invariants, Scenario);
-* :mod:`.scenarios` — the five named roadmap scenarios + ``SCENARIOS``
-  registry;
+* :mod:`.scenarios` — the named roadmap scenarios (five composed
+  fault scenarios + two durable kill/restart scenarios) +
+  ``SCENARIOS`` registry;
 * :mod:`.runner` — ``run(scenario) -> ScenarioResult``;
 * :mod:`.fixtures` — deterministic builders shared with the unit
   tiers (election fixtures, flood shapes).
 
-Driven by ``tools/chaos_sweep.py`` (check.sh stage 7); the scenario ×
+Driven by ``tools/chaos_sweep.py`` (check.sh stages 7-8); the scenario ×
 fault × invariant matrix is documented in docs/ANALYSIS.md.
 """
 
 from .runner import RunEnv, ScenarioResult, run
-from .scenario import Invariants, Phase, Scenario, Topology, Traffic
+from .scenario import Invariants, Kill, Phase, Scenario, Topology, Traffic
 from .scenarios import SCENARIOS
 
 __all__ = [
     "Invariants",
+    "Kill",
     "Phase",
     "RunEnv",
     "Scenario",

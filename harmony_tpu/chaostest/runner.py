@@ -71,6 +71,13 @@ class NodeHandle:
     sync_clients: list = field(default_factory=list)
     sidecar_client: object = None
     pump: object = None
+    host: object = None
+    data_path: str = None      # durable topologies: this node's FileKV
+    sync_port: int = 0         # stable across restarts (peers repoint
+    #                            lazily to the same port)
+    keys: list = None          # BLS keys, kept for restart wiring
+    killed_at: float = None    # monotonic time of the last hard kill
+    restarts: int = 0
 
 
 @dataclass
@@ -119,7 +126,7 @@ def _build(scenario: Scenario, registry, built: list | None = None
     from ..chain.engine import Engine, EpochContext
     from ..core.blockchain import Blockchain
     from ..core.genesis import Genesis, dev_genesis
-    from ..core.kv import MemKV
+    from ..core.kv import FileKV, MemKV
     from ..core.tx_pool import TxPool
     from ..multibls import PrivateKeys
     from ..node.node import Node
@@ -178,10 +185,12 @@ def _build(scenario: Scenario, registry, built: list | None = None
                 ctx_cache[key] = ctx
             return ctx
 
-    def mk_chain(shard: int):
+    def mk_chain(shard: int, data_path: str | None = None):
         """A full chain for ``shard``: trustless committee provider
         (each chain answers epochs from ITS OWN persisted elections),
-        optional finalizer, optional sidecar-backed engine.  Returns
+        optional finalizer, optional sidecar-backed engine.
+        ``data_path`` makes it durable (FileKV — reopening the same
+        path runs recovery-on-open).  Returns
         (chain, sidecar_client_or_None)."""
         client = None
         if env.sidecar_server is not None:
@@ -196,7 +205,8 @@ def _build(scenario: Scenario, registry, built: list | None = None
             )
 
         chain = Blockchain(
-            MemKV(), shard_genesis[shard],
+            FileKV(data_path) if data_path else MemKV(),
+            shard_genesis[shard],
             engine=Engine(provider, device=True, backend=client),
             blocks_per_epoch=top.blocks_per_epoch,
             finalizer=(
@@ -210,6 +220,53 @@ def _build(scenario: Scenario, registry, built: list | None = None
 
     env.data["mk_chain"] = mk_chain
 
+    def wire_node(handle: NodeHandle):
+        """(Re)build one node onto its handle: chain (durable when the
+        topology is), pool, registry, gossip host, sync server on the
+        handle's stable port, Node.  Shared by the initial build and
+        the kill/restart path — a restarted node goes through exactly
+        the wiring a fresh one does, on the same data dir."""
+        handle.chain, handle.sidecar_client = mk_chain(
+            handle.shard, handle.data_path
+        )
+        handle.pool = TxPool(CHAIN_ID, handle.shard, handle.chain.state)
+        handle.host = env.net.host(handle.name)
+        reg = Registry(
+            blockchain=handle.chain, txpool=handle.pool,
+            host=handle.host,
+        )
+        reg.set("metrics", registry)
+        if top.shards > 1:
+            reg.set("shard_count", top.shards)
+        handle.sync_server = SyncServer(
+            handle.chain, listen_port=handle.sync_port
+        )
+        handle.sync_port = handle.sync_server.port
+        handle.node = Node(reg, PrivateKeys.from_keys(handle.keys))
+        handle._registry = reg
+
+    def wire_sync(handle: NodeHandle):
+        """Point the handle's downloader at its current shard peers
+        (their ports are stable across restarts; SyncClient dials
+        lazily, so a peer being down is a per-call error)."""
+        peers = [p for p in env.by_shard(handle.shard) if p is not handle]
+        handle.sync_clients = [
+            SyncClient(p.sync_port, timeout=5.0) for p in peers
+        ]
+        if handle.sync_clients:
+            handle._registry.set("downloader", Downloader(
+                handle.chain, handle.sync_clients, verify_seals=True,
+                request_deadline_s=2.0,
+            ))
+
+    env.data["wire_node"] = wire_node
+    env.data["wire_sync"] = wire_sync
+
+    if top.durable:
+        import tempfile
+
+        env.data["data_dir"] = tempfile.mkdtemp(prefix="harmony-chaos-")
+
     for s in range(top.shards):
         for i in range(top.nodes):
             # the handle registers BEFORE its resources are allocated:
@@ -218,15 +275,10 @@ def _build(scenario: Scenario, registry, built: list | None = None
             # whatever this partial handle already owns
             handle = NodeHandle(name=f"s{s}n{i}", shard=s, index=i)
             env.handles.append(handle)
-            handle.chain, handle.sidecar_client = mk_chain(s)
-            handle.pool = TxPool(CHAIN_ID, s, handle.chain.state)
-            reg = Registry(
-                blockchain=handle.chain, txpool=handle.pool,
-                host=env.net.host(handle.name),
-            )
-            reg.set("metrics", registry)
-            if top.shards > 1:
-                reg.set("shard_count", top.shards)
+            if top.durable:
+                handle.data_path = os.path.join(
+                    env.data["data_dir"], f"{handle.name}.kv"
+                )
             key_index = sum(spans[:i])
             keys = list(bls_keys[key_index:key_index + spans[i]])
             if s == 0 and i < len(ext_keys):
@@ -234,24 +286,13 @@ def _build(scenario: Scenario, registry, built: list | None = None
                 # extra (multi-key) slot key: once the election seats
                 # it, the node votes with both
                 keys.append(ext_keys[i])
-            handle.sync_server = SyncServer(handle.chain)
-            handle.node = Node(reg, PrivateKeys.from_keys(keys))
-            handle._registry = reg
+            handle.keys = keys
+            wire_node(handle)
 
     # sync mesh per shard: every node can pull from every other —
     # consensus-timeout sync and post-heal rejoin both need a peer
     for h in env.handles:
-        peers = [
-            p for p in env.by_shard(h.shard) if p is not h
-        ]
-        h.sync_clients = [
-            SyncClient(p.sync_server.port, timeout=5.0) for p in peers
-        ]
-        if h.sync_clients:
-            h._registry.set("downloader", Downloader(
-                h.chain, h.sync_clients, verify_seals=True,
-                request_deadline_s=2.0,
-            ))
+        wire_sync(h)
 
     # staking topologies: register the external validators up front so
     # epoch 0's election block seats them (POPs verify on the INGRESS
@@ -310,35 +351,48 @@ def _replay_worker(env: RunEnv, stop):
     """Re-verify the committed shard-0 chain into fresh replicas — the
     SYNC-lane seal batches concurrent with live rounds (and, in the
     staking topology, across the election boundary)."""
+    from ..core.blockchain import ChainError
+
     mk_chain = env.data["mk_chain"]
-    src = env.by_shard(0)[0].chain
     try:
         while not stop.is_set():
-            head = src.head_number
-            if head < 1:
-                time.sleep(0.01)
-                continue
-            replica, client = mk_chain(0)
             try:
-                blocks, proofs = [], []
-                for n in range(1, head + 1):
-                    blk = src.block_by_number(n)
-                    proof = src.read_commit_sig(n)
-                    if blk is None or proof is None:
-                        break
-                    blocks.append(blk)
-                    proofs.append(proof)
-                if blocks:
-                    replica.insert_chain(blocks, commit_sigs=proofs,
-                                         verify_seals=True)
-            finally:
-                if client is not None:
-                    # per-iteration replica clients must not accumulate
-                    # sockets + reader threads across a long flap run
-                    try:
-                        client.close()
-                    except OSError:
-                        pass
+                # re-resolve the source each pass: a restarted node
+                # swaps its chain object, and the stale one stops at
+                # the head it died with
+                src = env.by_shard(0)[0].chain
+                head = src.head_number
+                if head < 1:
+                    time.sleep(0.01)
+                    continue
+                replica, client = mk_chain(0)
+                try:
+                    blocks, proofs = [], []
+                    for n in range(1, head + 1):
+                        blk = src.block_by_number(n)
+                        proof = src.read_commit_sig(n)
+                        if blk is None or proof is None:
+                            break
+                        blocks.append(blk)
+                        proofs.append(proof)
+                    if blocks:
+                        replica.insert_chain(blocks, commit_sigs=proofs,
+                                             verify_seals=True)
+                finally:
+                    if client is not None:
+                        # per-iteration replica clients must not
+                        # accumulate sockets + reader threads across a
+                        # long flap run
+                        try:
+                            client.close()
+                        except OSError:
+                            pass
+            except ChainError:
+                raise  # a real replay failure IS the finding
+            except (ValueError, OSError):
+                # a scripted kill/restart closed the source store out
+                # from under this pass: benign, retry on the new chain
+                time.sleep(0.05)
     except Exception as e:  # noqa: BLE001
         env.errors.append(f"replay worker: {e!r}")
 
@@ -382,6 +436,104 @@ def _cx_submitter(env: RunEnv, stop):
         env.errors.append(f"cx submitter: {e!r}")
 
 
+# -- kill / restart ----------------------------------------------------------
+
+
+def _kill_node(env: RunEnv, handle, torn_tail: bool = False) -> None:
+    """Hard-kill one node: stop its threads, drop its gossip host off
+    the hub, close its sync server socket.  NOTHING is flushed or
+    closed cleanly — FileKV writes are unbuffered, so exactly the
+    bytes a SIGKILLed process would leave in the OS page cache are
+    what the restart reopens.  ``torn_tail`` additionally stamps an
+    un-committed batch fragment onto the dead node's log (BEGIN marker
+    + a half-written record): the live commit path self-heals its own
+    injected failures by truncating, so a kill-during-write's torn
+    bytes must be laid down here for the restart to REALLY exercise
+    replay discard on a node data dir."""
+    h = handle
+    if h.node is None:
+        return
+    # snapshot equivocation evidence BEFORE the node object is
+    # replaced: the no_double_sign invariant must see what a later-
+    # killed leader had collected, not just the survivors' lists
+    if h.node.pending_double_signs:
+        env.data.setdefault("double_signs", []).extend(
+            h.node.pending_double_signs
+        )
+    h.killed_at = time.monotonic()
+    h.node.stop()
+    if h.pump is not None:
+        h.pump.join(timeout=10)
+    # the background downloader also writes the chain store: it must
+    # be DEAD before a restart opens a second writer on the same file
+    # (the loop checks node._stop, so this join is bounded by one
+    # sync_once pass)
+    sync_thread = getattr(h.node, "_sync_thread", None)
+    if sync_thread is not None:
+        sync_thread.join(timeout=10)
+    if h.host is not None:
+        env.net.remove(h.host)
+    if h.sync_server is not None:
+        h.sync_server.close()
+    for c in h.sync_clients:
+        try:
+            c.close()
+        except OSError:
+            pass
+    h.sync_clients = []
+    if torn_tail and h.data_path is not None:
+        import struct as _struct
+
+        with open(h.data_path, "ab") as f:
+            # BEGIN claiming 3 records, then one record cut mid-value:
+            # the shape a kill mid-batch leaves on disk
+            f.write(_struct.pack("<II", 0xFFFFFFFE, 3)
+                    + _struct.pack("<II", 4, 100) + b"torn" + b"par")
+    _log.warn("chaos node killed", node=h.name,
+              head=h.chain.head_number, torn_tail=torn_tail)
+
+
+def _restart_node(env: RunEnv, handle) -> None:
+    """Reopen a killed node from its data dir: FileKV replay discards
+    any torn batch, Blockchain recovery-on-open verifies the head, the
+    SafetyStore reloads the durable last-signed views, and the node
+    rejoins consensus via the sync mesh (same port as before — peers'
+    lazy clients reconnect by themselves)."""
+    h = handle
+    # belt and braces against any straggler writer: close the dead
+    # node's store handle before the new one opens the file — a racer
+    # then fails loudly on a closed file instead of corrupting the log
+    # (everything written pre-kill is already with the OS; FileKV is
+    # unbuffered)
+    if h.chain is not None:
+        try:
+            h.chain.db.close()
+        except (OSError, ValueError):
+            pass
+    if h.sidecar_client is not None:
+        # wire_node dials a fresh client: the dead node's socket +
+        # reader thread must not accumulate across a rolling run
+        try:
+            h.sidecar_client.close()
+        except OSError:
+            pass
+    env.data["wire_node"](h)
+    env.data["wire_sync"](h)
+    h.restarts += 1
+    top = env.scenario.topology
+    h.pump = h.node.run_forever(
+        poll_interval=0.002,
+        block_time=top.block_time_s,
+        phase_timeout=top.phase_timeout_s,
+    )
+    _log.warn(
+        "chaos node restarted", node=h.name,
+        recovered_head=h.chain.head_number,
+        rolled_back=h.chain.recovered_blocks,
+        restarts=h.restarts,
+    )
+
+
 # -- the fault-script timeline -----------------------------------------------
 
 
@@ -410,12 +562,24 @@ def _resolve_partition(env: RunEnv, spec: str) -> list:
 def _timeline(env: RunEnv, stop, t0: float, phases_done):
     """Execute the scenario's fault script: trigger each phase on its
     round/time condition, arm its faultinject rules with the window's
-    expiry, black-hole its partitions, heal at window end."""
+    expiry, black-hole its partitions, execute its kill specs (tear →
+    kill → restart → measure recovery), heal at window end."""
     pending = list(env.scenario.phases)
     active: list = []  # (phase, end_monotonic_or_None, names)
+    # kill tasks: {"h", "kill", "state", "deadline"/"restart_at"}
+    # armed -> down -> recovering -> done
+    kills: list = []
+    by_name = {h.name: h for h in env.handles}
+
+    def kill_open(t):
+        return t["state"] in ("armed", "down", "recovering")
+
     try:
-        while not stop.is_set() and (pending or active):
-            now_s = time.monotonic() - t0
+        while not stop.is_set() and (
+            pending or active or any(kill_open(t) for t in kills)
+        ):
+            now = time.monotonic()
+            now_s = now - t0
             head = env.shard_head(0)
             for phase in pending[:]:
                 hit = (
@@ -436,6 +600,27 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                     if phase.duration_s is not None:
                         kw.setdefault("t1", phase.duration_s)
                     FI.arm(**kw)
+                for kill in phase.kills:
+                    for nm in _resolve_partition(env, kill.target):
+                        h = by_name.get(nm)
+                        if h is None or h.node is None:
+                            continue
+                        task = {"h": h, "kill": kill, "state": "armed",
+                                "deadline": now}
+                        if (kill.mode == "mid_commit"
+                                and h.data_path is not None):
+                            # tear the target's NEXT block commit
+                            # mid-batch, then kill it: the worst-case
+                            # crash the batch layer must absorb.  The
+                            # grace deadline covers a wedged round
+                            # (no commit to tear) — kill anyway.
+                            FI.arm("kv.commit", key=h.data_path,
+                                   after=1, times=1)
+                            task["deadline"] = now + max(
+                                4 * env.scenario.topology.block_time_s,
+                                2.0,
+                            )
+                        kills.append(task)
                 end = (None if phase.duration_s is None
                        else time.monotonic() + phase.duration_s)
                 active.append((phase, end, names))
@@ -443,7 +628,7 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                     "chaos phase armed", phase=phase.name,
                     at_round=head, t_s=round(now_s, 2),
                     partitioned=",".join(names) or "-",
-                    arms=len(phase.arms),
+                    arms=len(phase.arms), kills=len(phase.kills),
                 )
             for entry in active[:]:
                 phase, end, names = entry
@@ -452,13 +637,61 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                         env.net.partitioned.discard(nm)
                     active.remove(entry)
                     _log.warn("chaos phase healed", phase=phase.name)
+            for task in kills:
+                h, kill = task["h"], task["kill"]
+                if task["state"] == "armed":
+                    torn = (
+                        kill.mode == "mid_commit"
+                        and h.data_path is not None
+                        and FI.fired("kv.commit", key=h.data_path) > 0
+                    )
+                    if torn or now >= task["deadline"]:
+                        _kill_node(
+                            env, h,
+                            torn_tail=kill.mode == "mid_commit",
+                        )
+                        if kill.restart_after_s is None:
+                            task["state"] = "done"
+                        else:
+                            task["state"] = "down"
+                            task["restart_at"] = (
+                                time.monotonic() + kill.restart_after_s
+                            )
+                elif task["state"] == "down":
+                    if now >= task["restart_at"]:
+                        _restart_node(env, h)
+                        task["state"] = "recovering"
+                elif task["state"] == "recovering":
+                    # recovered = caught up to the live network head
+                    if h.node.chain.head_number >= env.shard_head(h.shard):
+                        env.data.setdefault("recovery_s", []).append(
+                            time.monotonic() - h.killed_at
+                        )
+                        task["state"] = "done"
+                        _log.warn(
+                            "chaos node recovered", node=h.name,
+                            head=h.node.chain.head_number,
+                            kill_to_caught_up_s=round(
+                                time.monotonic() - h.killed_at, 2
+                            ),
+                        )
             time.sleep(0.05)
     finally:
         # scenario end or abort: heal every partition we created
-        # (armed rules expire by their own t1 windows)
+        # (armed rules expire by their own t1 windows); a node still
+        # DOWN with a pending restart is restarted so teardown and
+        # invariants see the recovered shape, not a half-run script
         for _, _, names in active:
             for nm in names:
                 env.net.partitioned.discard(nm)
+        for task in kills:
+            if task["state"] == "down" and not stop.is_set():
+                try:
+                    _restart_node(env, task["h"])
+                except Exception as e:  # noqa: BLE001
+                    env.errors.append(
+                        f"restart {task['h'].name}: {e!r}"
+                    )
         phases_done.set()
 
 
@@ -597,7 +830,7 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
     DV.use_device(True)
     sheds_before = _consensus_sheds()
     fi_points = ("device.dispatch", "sidecar.call", "sidecar.frame",
-                 "p2p.stream", "webhook.post")
+                 "p2p.stream", "webhook.post", "kv.commit")
     hits_before = {p: FI.hits(p) for p in fi_points}
 
     stop = threading.Event()
@@ -658,14 +891,13 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
 
         for t in threads:
             t.start()
-        pumps = [
-            h.node.run_forever(
+        for h in env.handles:
+            h.pump = h.node.run_forever(
                 poll_interval=0.002,
                 block_time=scenario.topology.block_time_s,
                 phase_timeout=scenario.topology.phase_timeout_s,
             )
-            for h in env.handles
-        ]
+        pumps = [h.pump for h in env.handles]
         ready.set()
 
         deadline = t0 + scenario.window_s
@@ -717,6 +949,10 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
                     h.node.stop()
             for p in pumps:
                 p.join(timeout=10)
+            for h in env.handles:
+                # restarted nodes run on a fresh pump thread
+                if h.pump is not None and h.pump not in pumps:
+                    h.pump.join(timeout=10)
             # heal any leftover partition before invariant checks
             env.net.partitioned.clear()
             for h in env.handles:
@@ -766,6 +1002,22 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         if path:
             violation_dumps.append(path)
 
+    # durable stores stay OPEN through invariant evaluation (the fork
+    # and custom checks read blocks back); release them only now
+    for h in env.handles:
+        if h.chain is not None:
+            try:
+                h.chain.db.close()
+            except OSError:
+                pass
+    data_dir = env.data.get("data_dir")
+    if data_dir:
+        # the on-disk evidence of a violation is the flight-recorder
+        # dump, not the raw KV logs
+        import shutil
+
+        shutil.rmtree(data_dir, ignore_errors=True)
+
     p50, p99 = _quantiles(list(env.round_durs.values()))
     heads = {
         s: [h.node.chain.head_number for h in env.by_shard(s)]
@@ -800,6 +1052,16 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         "run_s": _m(round(run_s, 2), "s",
                     window_s=scenario.window_s),
     }
+    restarts = sum(h.restarts for h in env.handles)
+    if restarts:
+        recov = env.data.get("recovery_s", [])
+        _, rec_p99 = _quantiles(recov)
+        metrics["node_restarts"] = _m(restarts, "restarts",
+                                      recovered=len(recov))
+        metrics["restart_recovery_seconds_p99"] = _m(
+            rec_p99 and round(rec_p99, 3), "s", restarts=restarts,
+            derived_from="kill_to_caught_up",
+        )
     return ScenarioResult(
         name=scenario.name,
         passed=not violations,

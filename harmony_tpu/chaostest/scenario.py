@@ -13,9 +13,11 @@ ROADMAP item 5's vocabulary.  A :class:`Scenario` composes
   workers re-verifying the committed chain down the SYNC lane, and
   cross-shard transfers;
 * a **fault script** — timed/round-triggered phases arming
-  ``faultinject`` rules (now window-capable: ``t0``/``t1``/``when``)
-  and partitioning nodes out of the gossip hub ("black-hole the
-  leader at round 3 for 10 s");
+  ``faultinject`` rules (now window-capable: ``t0``/``t1``/``when``),
+  partitioning nodes out of the gossip hub ("black-hole the
+  leader at round 3 for 10 s"), and — on a ``durable`` topology —
+  hard-killing nodes (optionally tearing their in-flight storage
+  batch first) and restarting them from disk;
 * **invariants** — the machine-checked postconditions: liveness (the
   chain advances ≥ N blocks inside the window), ZERO consensus-lane
   sheds, a round-p99 bound, no divergent heads, plus scenario-specific
@@ -45,6 +47,8 @@ class Topology:
     external_validators: int = 0  # staked external keys; key i rides
     #                               node i as an extra (multi-key) key
     sidecar: bool = False      # engines verify seals via a sidecar
+    durable: bool = False      # per-node FileKV data dirs: nodes can be
+    #                            hard-killed and reopened from disk
     block_time_s: float = 0.25
     phase_timeout_s: float = 8.0  # consensus timeout -> view change
 
@@ -58,6 +62,29 @@ class Traffic:
     replay_workers: int = 0    # chain re-verification loops (SYNC)
     cross_shard_transfers: int = 0  # shard-0 -> shard-1 transfers
     flood_duration_s: float = 6.0   # how long the paced floods run
+
+
+@dataclass(frozen=True)
+class Kill:
+    """One hard node kill inside a phase (requires
+    ``Topology(durable=True)`` — a restarted node reopens from disk).
+
+    ``target`` uses the partition spec grammar (literal ``"s0n1"``,
+    ``"leader"``, ``"round_leader[:shard]"``).  ``mode="mid_commit"``
+    arms a one-shot ``kv.commit`` crash point on the target's store
+    (killing its next block commit; the live commit path self-heals
+    by truncating) AND stamps an un-committed batch fragment onto the
+    dead node's log, so the restart genuinely exercises torn-batch
+    replay discard — the worst-case kill the atomic batch layer must
+    absorb; ``mode="clean"`` just kills (no flush, no close — writes
+    already on disk survive, in-memory consensus state is lost).
+    ``restart_after_s`` reopens the node from its data dir after the
+    delay (None = stays down for the rest of the run); the runner
+    measures kill-to-caught-up as ``restart_recovery_seconds``."""
+
+    target: str
+    mode: str = "clean"          # "clean" | "mid_commit"
+    restart_after_s: float | None = None
 
 
 @dataclass(frozen=True)
@@ -80,6 +107,7 @@ class Phase:
     duration_s: float | None = None
     arms: tuple = ()
     partition: tuple = ()
+    kills: tuple = ()  # Kill specs executed at trigger time
 
 
 @dataclass(frozen=True)

@@ -1,4 +1,6 @@
-"""The five named adversarial scenarios (ROADMAP item 5).
+"""The named adversarial scenarios (ROADMAP items 5 + the durability
+leg of item 2): five composed fault scenarios plus two
+kill/restart-from-disk scenarios on durable topologies (ISSUE 12).
 
 Each builder returns a :class:`~.scenario.Scenario`; ``quick=True``
 scales durations/targets down to the check.sh stage budget while
@@ -7,12 +9,12 @@ same fault script, the same invariants.  ``SCENARIOS`` is the sweep
 registry (``tools/chaos_sweep.py`` iterates it).
 
 Scenario × fault × invariant rationale lives in docs/ANALYSIS.md
-("Scenario matrix").
+("Scenario matrix" + "Crash-consistency invariants").
 """
 
 from __future__ import annotations
 
-from .scenario import Invariants, Phase, Scenario, Topology, Traffic
+from .scenario import Invariants, Kill, Phase, Scenario, Topology, Traffic
 
 
 def _committee_rotated(env):
@@ -236,10 +238,160 @@ def sidecar_flap(quick: bool = False) -> Scenario:
     )
 
 
+def _kills_recovered(env):
+    """Restart scenarios: every scripted kill with a restart must have
+    actually restarted AND caught back up to the network head (the
+    runner measures kill-to-caught-up per restart)."""
+    planned = sum(
+        1 for p in env.scenario.phases for k in p.kills
+        if k.restart_after_s is not None
+    )
+    restarts = sum(h.restarts for h in env.handles)
+    recovered = len(env.data.get("recovery_s", []))
+    if restarts < planned:
+        return False, f"only {restarts}/{planned} kills restarted"
+    if recovered < planned:
+        return False, (
+            f"{recovered}/{planned} restarted nodes caught up to the "
+            "network head"
+        )
+    return True, ""
+
+
+def _no_double_sign(env):
+    """A restarted validator must never emit a conflicting vote: the
+    leaders' equivocation detectors (Node._check_double_sign) must
+    have collected ZERO evidence records across the run — including
+    evidence held by nodes that were themselves killed later (the
+    runner snapshots it into env.data at kill time)."""
+    evidence = sum(
+        len(h.node.pending_double_signs)
+        for h in env.handles if h.node is not None
+    ) + len(env.data.get("double_signs", []))
+    if evidence:
+        return False, (
+            f"{evidence} double-sign evidence record(s) collected by "
+            "round leaders"
+        )
+    return True, ""
+
+
+def leader_kill_restart(quick: bool = False) -> Scenario:
+    """The production fault class no scenario had ever exercised: the
+    round leader hard-killed MID-COMMIT (its in-flight storage batch
+    torn at a kv.commit crash point) on a durable topology, then
+    restarted from disk.  The committee must view-change past the
+    dead leader and keep committing; the restarted node must reopen
+    its FileKV (replay discards the torn batch), recover a consistent
+    head, rejoin via the sync mesh, catch up — and, with its durable
+    last-signed-view state, never emit a conflicting vote for the
+    round it died in."""
+    return Scenario(
+        name="leader_kill_restart",
+        seed=29,
+        topology=Topology(
+            nodes=4, durable=True, block_time_s=0.2,
+            phase_timeout_s=2.0 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=150.0 if quick else 500.0,
+            pop_rate=6.0, replay_workers=1,
+            flood_duration_s=5.0 if quick else 10.0,
+        ),
+        phases=(
+            Phase(
+                "kill-leader-mid-commit", at_round=2,
+                duration_s=1.0,  # kills manage their own lifecycle;
+                # a finite window lets the run complete the moment the
+                # restart recovers instead of idling out the scenario
+                kills=(
+                    Kill("round_leader", mode="mid_commit",
+                         restart_after_s=4.0 if quick else 8.0),
+                ),
+            ),
+        ),
+        # the SHARP invariants are kills_recovered + no_double_sign +
+        # no_divergent_heads: a kill/restart scenario's worst committed
+        # round SPANS the kill -> view-change-storm -> recovery window
+        # by design, and with few rounds p99 = max — so the latency
+        # bound only guards against a full wedge
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=90.0,
+            min_view_changes=1,
+            custom=(
+                ("kills_recovered", _kills_recovered),
+                ("no_double_sign", _no_double_sign),
+            ),
+        ),
+        window_s=110.0 if quick else 220.0,
+    )
+
+
+def rolling_restart(quick: bool = False) -> Scenario:
+    """Rolling restarts of EVERY validator under sustained load (the
+    operator's routine upgrade path): one node at a time is hard-
+    killed and reopened from its data dir while floods + replay ride
+    the lanes.  The committee never loses quorum (3-of-4 stays live),
+    every restarted node recovers from disk and catches up, heads
+    never diverge, and kill-to-caught-up p99 lands in the BENCH
+    ledger as restart_recovery_seconds_p99."""
+    restart_s = 2.0 if quick else 4.0
+    return Scenario(
+        name="rolling_restart",
+        seed=31,
+        topology=Topology(
+            nodes=4, durable=True, block_time_s=0.25,
+            # short VC timeout: each kill wedges the rounds whose
+            # leader slot the dead node holds, and the wedge cost is
+            # the escalating vc_timeout ladder — a tight base keeps
+            # four consecutive wedges inside the window
+            phase_timeout_s=2.0 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=150.0 if quick else 400.0,
+            replay_workers=1,
+            flood_duration_s=6.0 if quick else 12.0,
+        ),
+        # kills at rounds 1/3/5/7: the floor sits ABOVE the last kill
+        # round, so passing proves the committee kept committing
+        # through (and after) the full rolling cycle — and the tail of
+        # the window belongs to the final recovery, not a fresh wedge
+        phases=tuple(
+            Phase(
+                f"restart-n{3 - i}", at_round=1 + 2 * i,
+                duration_s=1.0,  # see leader_kill_restart: kill tasks
+                # outlive the phase window by design
+                kills=(
+                    Kill(f"s0n{3 - i}", restart_after_s=restart_s),
+                ),
+            )
+            for i in range(4)
+        ),
+        # same p99 rationale as leader_kill_restart: rounds spanning a
+        # kill window dominate a small-sample p99.  The window is
+        # sized for the UNLUCKY interleaving (every kill landing on
+        # the upcoming leader slot): the run completes early the
+        # moment all floors + customs hold, so the slack only costs
+        # wall-clock when it is actually needed
+        invariants=Invariants(
+            min_blocks=8 if quick else 12,
+            round_p99_s=90.0,
+            custom=(
+                ("kills_recovered", _kills_recovered),
+                ("no_double_sign", _no_double_sign),
+            ),
+        ),
+        window_s=300.0 if quick else 480.0,
+    )
+
+
 SCENARIOS = {
     "view_change_storm": view_change_storm,
     "epoch_election_rotation": epoch_election_rotation,
     "cross_shard_partition": cross_shard_partition,
     "validator_churn": validator_churn,
     "sidecar_flap": sidecar_flap,
+    "leader_kill_restart": leader_kill_restart,
+    "rolling_restart": rolling_restart,
 }

@@ -64,6 +64,10 @@ DEFAULTS = {
     "dev_keys": None,
     "dev_key_index": None,
     "in_memory": False,
+    # storage durability: fsync policy of the shard DB ("none" = OS-
+    # buffered, "batch" = fsync every atomic block-commit batch —
+    # a committed block survives power loss, "always" = every write)
+    "fsync": "batch",
     "log_level": "info",
     "log_path": None,
     # None = auto (TPU ops when an accelerator backend is live);
@@ -141,6 +145,7 @@ def _open_db(cfg: dict):
     if cfg["in_memory"]:
         return MemKV()
     db_path = os.path.join(cfg["datadir"], f"shard{cfg['shard_id']}.db")
+    fsync = cfg.get("fsync", "batch")
     if cfg.get("native_kv", True):
         # ANY native failure (missing toolchain, corrupt file ->
         # kv_open nullptr, ...) falls back to the Python twin —
@@ -148,13 +153,13 @@ def _open_db(cfg: dict):
         try:
             from .core.kv_native import NativeKV
 
-            return NativeKV(db_path)
+            return NativeKV(db_path, fsync=fsync)
         except Exception as e:  # documented above: ANY native failure
             get_logger("cli").warn(
                 "native kv unavailable, using FileKV twin",
                 path=db_path, error=str(e),
             )
-    return FileKV(db_path)
+    return FileKV(db_path, fsync=fsync)
 
 
 def open_chain_for_maintenance(cfg: dict) -> Blockchain:
@@ -534,6 +539,11 @@ def main(argv=None):
     p.add_argument("--sidecar-addr", dest="sidecar_addr")
     p.add_argument("--no-native-kv", action="store_const", const=False,
                    default=None, dest="native_kv")
+    p.add_argument("--fsync", dest="fsync",
+                   choices=["none", "batch", "always"],
+                   help="shard-DB durability: fsync every atomic "
+                        "block-commit batch (default), every write, "
+                        "or never (OS-buffered)")
     p.add_argument("--skip-ntp-check", action="store_const", const=False,
                    default=None, dest="ntp_check")
     p.add_argument("--log-level", dest="log_level",

@@ -19,11 +19,15 @@ from __future__ import annotations
 import threading
 
 from ..chain.header import Header
+from ..log import get_logger
 from .genesis import Genesis
+from .kv import WriteBatch, commit_batch
 from .state import StateDB
 from .state_processor import StateProcessor
 from .types import Block
 from . import rawdb, types
+
+_log = get_logger("chain")
 
 
 def verify_cx_proof(proof, dest_shard: int, engine, config) -> bool:
@@ -84,12 +88,18 @@ class ChainError(ValueError):
 class Blockchain:
     def __init__(self, db, genesis: Genesis, engine=None,
                  blocks_per_epoch: int = 32768, finalizer=None,
-                 state_retention: int | None = None):
+                 state_retention: int | None = None,
+                 require_commit_sigs: bool | None = None):
         """engine: chain.engine.Engine or None (no seal checks — tests
         and block production before wiring consensus).  finalizer:
         chain.finalize.Finalizer or None (no rewards/election — the
         pre-staking chain shape).  state_retention: keep only the last
-        N block states (None = archive node, every state kept)."""
+        N block states (None = archive node, every state kept).
+        require_commit_sigs: recovery-on-open additionally requires a
+        stored commit proof at every candidate head (None = derived
+        from ``engine is not None`` — consensus-wired nodes always
+        persist the proof with the block; proof-less test chains do
+        not)."""
         self.db = db
         self.state_retention = state_retention
         self.genesis = genesis
@@ -100,6 +110,11 @@ class Blockchain:
         self.blocks_per_epoch = blocks_per_epoch
         self.processor = StateProcessor(self.config.chain_id, self.shard_id)
         self._committee_cache: dict[int, list] = {}
+        self.recovered_blocks = 0  # head rollback depth at last open
+        self._require_commit_sigs = (
+            engine is not None if require_commit_sigs is None
+            else require_commit_sigs
+        )
         # insert_chain can be reached from two threads at once: the
         # consensus pump (commit path) and the background downloader
         # (node._spin_up_sync) — serialize writers
@@ -108,19 +123,82 @@ class Blockchain:
         if head is None:
             self._init_genesis()
         else:
-            self._head_num = head
-            self._state = self._load_state_at(head)
+            self._head_num, self._state = self._recover_head(head)
 
     # -- bootstrap ---------------------------------------------------------
 
     def _init_genesis(self):
         block = self.genesis.build_block()
         state = self.genesis.build_state()
-        rawdb.write_block(self.db, block, self.config.chain_id)
-        rawdb.write_state(self.db, block.header.root, state.serialize())
-        rawdb.write_head_number(self.db, 0)
+        batch = WriteBatch()
+        rawdb.write_block(batch, block, self.config.chain_id)
+        rawdb.write_state(batch, block.header.root, state.serialize())
+        rawdb.write_head_number(batch, 0)
+        commit_batch(self.db, batch)
         self._head_num = 0
         self._state = state
+
+    def _block_complete(self, num: int):
+        """The stored Header of block ``num`` if its block records are
+        whole — header present, canonical hash matches, commit proof
+        present where this chain requires one — else None.  State is
+        judged separately: a pruned node legitimately has no state
+        below head, and that must NOT read as a torn block."""
+        header = rawdb.read_header(self.db, num)
+        if header is None:
+            return None
+        if rawdb.read_canonical_hash(self.db, num) != header.hash():
+            return None
+        if self._require_commit_sigs and num > 0 and (
+            rawdb.read_commit_sig(self.db, num) is None
+        ):
+            return None
+        return header
+
+    def _recover_head(self, head: int):
+        """Reopen-time head verification (the role of the reference's
+        loadLastState + its SetHead repair, core/blockchain_impl.go):
+        serve ``head`` only if its block records are whole and its
+        state loads + re-derives the sealed root; roll back across any
+        TORN blocks (missing header/canonical/proof, corrupt state
+        blob) to the newest whole one.  A whole block whose state blob
+        is simply ABSENT is a pruned/snapshot-restorable store, not a
+        tear: raise the classic "missing state" instead of destroying
+        the block records a snapshot import needs.  With atomic commit
+        batches a tear can only come from a pre-batch DB or external
+        damage — but a restarted node must NEVER crash on (or silently
+        serve) one."""
+        for num in range(head, -1, -1):
+            header = self._block_complete(num)
+            if header is None:
+                continue
+            blob = rawdb.read_state(self.db, header.root)
+            if blob is None:
+                raise ChainError(
+                    f"missing state for root at block {num}"
+                )
+            try:
+                state = StateDB.deserialize(blob)
+            except (ValueError, IndexError, KeyError):
+                continue  # corrupt state blob: torn, keep walking
+            if self.config.state_root(state, header.epoch) != header.root:
+                continue
+            if num < head:
+                batch = WriteBatch()
+                for n in range(head, num, -1):
+                    rawdb.delete_canonical(self.db, n, w=batch)
+                rawdb.write_head_number(batch, num)
+                commit_batch(self.db, batch)
+                self.recovered_blocks = head - num
+                _log.warn(
+                    "torn head rolled back on open", stored_head=head,
+                    recovered_head=num, shard=self.shard_id,
+                )
+            return num, state
+        raise ChainError(
+            f"no consistent head at or below {head}: storage is "
+            "damaged beyond rollback (genesis itself is torn)"
+        )
 
     def _load_state_at(self, num: int) -> StateDB:
         header = rawdb.read_header(self.db, num)
@@ -286,6 +364,7 @@ class Blockchain:
             target = self.header_by_number(num)
             if target is None:
                 raise ChainError(f"no canonical block {num} to revert to")
+            batch = WriteBatch()
             for n in range(head, num, -1):
                 # un-mark cx batches the reverted block consumed —
                 # re-syncing the same block must not read as a double
@@ -298,10 +377,13 @@ class Blockchain:
                         except (ValueError, IndexError):
                             continue
                         rawdb.delete_cx_spent(
-                            self.db, src.shard_id, src.block_num
+                            batch, src.shard_id, src.block_num
                         )
-                rawdb.delete_canonical(self.db, n)
-            rawdb.write_head_number(self.db, num)
+                rawdb.delete_canonical(self.db, n, w=batch)
+            rawdb.write_head_number(batch, num)
+            # the whole revert is ONE atomic commit: a crash mid-revert
+            # must not leave the head pointing above deleted blocks
+            commit_batch(self.db, batch)
             self._head_num = num
             self._state = self._load_state_at(num)
             self._committee_cache.clear()
@@ -433,9 +515,12 @@ class Blockchain:
                     seg, commit_sigs[start:i + 1], parent, verify_seals
                 )
                 for b, proof in zip(seg, proofs):
-                    rawdb.write_block(self.db, b, self.config.chain_id)
+                    # one atomic batch per fast block: reopen never
+                    # sees a block without its proof or spent marks
+                    batch = WriteBatch()
+                    rawdb.write_block(batch, b, self.config.chain_id)
                     if proof is not None:
-                        rawdb.write_commit_sig(self.db, b.block_num, proof)
+                        rawdb.write_commit_sig(batch, b.block_num, proof)
                     for cxp in b.incoming_receipts:
                         try:
                             src = rawdb.decode_header(cxp.header_bytes)
@@ -446,19 +531,19 @@ class Blockchain:
                                 f"{b.block_num}: {e}"
                             ) from e
                         rawdb.write_cx_spent(
-                            self.db, src.shard_id, src.block_num,
+                            batch, src.shard_id, src.block_num,
                             spender=b.block_num,
                         )
-                if block.header.shard_state:
-                    elected = rawdb.decode_shard_state(
-                        block.header.shard_state
-                    )
-                    rawdb.write_shard_state(
-                        self.db, block.header.epoch + 1, elected
-                    )
-                    self._committee_cache.pop(
-                        block.header.epoch + 1, None
-                    )
+                    if b.header.shard_state:
+                        rawdb.write_shard_state(
+                            batch, b.header.epoch + 1,
+                            rawdb.decode_shard_state(b.header.shard_state),
+                        )
+                    commit_batch(self.db, batch)
+                    if b.header.shard_state:
+                        self._committee_cache.pop(
+                            b.header.epoch + 1, None
+                        )
                 parent = block.header
                 start = i + 1
             return len(blocks)
@@ -480,8 +565,12 @@ class Blockchain:
                     "adopt_state: downloaded accounts do not match the "
                     f"sealed state root of block {num}"
                 )
-            rawdb.write_state(self.db, header.root, state.serialize())
-            rawdb.write_head_number(self.db, num)
+            batch = WriteBatch()
+            rawdb.write_state(batch, header.root, state.serialize())
+            rawdb.write_head_number(batch, num)
+            # state + head move TOGETHER: a crash between them would
+            # otherwise leave a head with no state to serve
+            commit_batch(self.db, batch)
             self._head_num = num
             self._state = state
             self._committee_cache.clear()
@@ -562,42 +651,54 @@ class Blockchain:
         return inserted
 
     def _execute_segment(self, blocks, proofs):
-        """Execution + persistence pass over verified blocks."""
+        """Execution + persistence pass over verified blocks.
+
+        EVERY per-block write — block, state, receipts, commit proof,
+        spent marks, outgoing cx, elected shard state, head pointer —
+        stages into ONE WriteBatch committed atomically (the role of
+        the reference's WriteBlockWithState batch over LevelDB): a
+        crash at any byte of the commit leaves the previous head fully
+        intact, never a block without its state or proof."""
         inserted = 0
         for block, proof in zip(blocks, proofs):
             spent_keys = self.verify_incoming_receipts(block)
             state, result, elected = self._execute(block)
+            batch = WriteBatch()
             for from_shard, num in spent_keys:
                 rawdb.write_cx_spent(
-                    self.db, from_shard, num, spender=block.block_num
+                    batch, from_shard, num, spender=block.block_num
                 )
             if elected is not None:
-                rawdb.write_shard_state(self.db, elected.epoch, elected)
-                self._committee_cache.pop(elected.epoch, None)
-            rawdb.write_block(self.db, block, self.config.chain_id)
-            rawdb.write_state(self.db, block.header.root, state.serialize())
+                rawdb.write_shard_state(batch, elected.epoch, elected)
+            rawdb.write_block(batch, block, self.config.chain_id)
+            rawdb.write_state(batch, block.header.root, state.serialize())
             rawdb.write_receipts(
-                self.db, block.block_num,
+                batch, block.block_num,
                 result.receipts + result.staking_receipts,
             )
             if proof is not None:
-                rawdb.write_commit_sig(self.db, block.block_num, proof)
-            if self.state_retention:
-                # incremental prune: the state falling out of the
-                # retention window (O(1) per insert; core/snapshot.py)
-                from .snapshot import prune_state_at
-
-                prune_state_at(
-                    self, block.block_num - self.state_retention
-                )
+                rawdb.write_commit_sig(batch, block.block_num, proof)
             by_shard: dict[int, list] = {}
             for cx in result.outgoing_cx:
                 by_shard.setdefault(cx.to_shard, []).append(cx)
             for to_shard, cxs in by_shard.items():
                 rawdb.write_outgoing_cx(
-                    self.db, to_shard, block.block_num, cxs
+                    batch, to_shard, block.block_num, cxs
                 )
-            rawdb.write_head_number(self.db, block.block_num)
+            rawdb.write_head_number(batch, block.block_num)
+            commit_batch(self.db, batch)
+            if elected is not None:
+                self._committee_cache.pop(elected.epoch, None)
+            if self.state_retention:
+                # incremental prune AFTER the commit: the state falling
+                # out of the retention window (O(1) per insert;
+                # core/snapshot.py).  Losing a prune to a crash costs
+                # one extra state blob, never consistency.
+                from .snapshot import prune_state_at
+
+                prune_state_at(
+                    self, block.block_num - self.state_retention
+                )
             self._head_num = block.block_num
             self._state = state
             inserted += 1

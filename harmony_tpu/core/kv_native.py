@@ -50,6 +50,13 @@ def _load():
     ]
     lib.kv_len.restype = ctypes.c_uint64
     lib.kv_len.argtypes = [ctypes.c_void_p]
+    lib.kv_write_batch.restype = ctypes.c_int
+    lib.kv_write_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint32,
+    ]
+    lib.kv_config.restype = ctypes.c_int
+    lib.kv_config.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.kv_flush.restype = ctypes.c_int
     lib.kv_flush.argtypes = [ctypes.c_void_p]
     lib.kv_compact.restype = ctypes.c_int
@@ -80,49 +87,115 @@ def available() -> bool:
 class NativeKV:
     """Drop-in for kv.FileKV backed by the C++ store."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fsync: str = "none"):
+        from .kv import FSYNC_POLICIES
+
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"fsync policy {fsync!r} not in "
+                             f"{FSYNC_POLICIES}")
+        import threading
+
         lib = _load()
         self._lib = lib
         self._h = lib.kv_open(path.encode())
         if not self._h:
             raise OSError(f"kv_open failed for {path}")
         self.path = path
+        self.fsync = fsync
+        # the C handle shares one FILE* (file position!) and one
+        # returned-value buffer: a node is multi-threaded, so every
+        # call serializes here — same discipline as FileKV
+        self._lock = threading.RLock()
+        # the native store fsyncs batch commits itself; the "always"
+        # policy additionally flushes per put/delete from this side
+        lib.kv_config(self._h, 1 if fsync in ("batch", "always") else 0)
 
     def get(self, key: bytes):
-        vlen = ctypes.c_uint32(0)
-        ptr = self._lib.kv_get(
-            self._h, key, len(key), ctypes.byref(vlen)
-        )
-        if not ptr:
-            return None
-        return ctypes.string_at(ptr, vlen.value)
+        with self._lock:
+            vlen = ctypes.c_uint32(0)
+            ptr = self._lib.kv_get(
+                self._h, key, len(key), ctypes.byref(vlen)
+            )
+            if not ptr:
+                return None
+            return ctypes.string_at(ptr, vlen.value)
 
     def put(self, key: bytes, value: bytes):
-        if self._lib.kv_put(self._h, key, len(key), value,
-                            len(value)) != 0:
-            raise OSError("kv_put failed")
+        with self._lock:
+            if self._lib.kv_put(self._h, key, len(key), value,
+                                len(value)) != 0:
+                raise OSError("kv_put failed")
+            if self.fsync == "always":
+                self._lib.kv_flush(self._h)  # fflush + fsync
 
     def delete(self, key: bytes):
-        if self._lib.kv_delete(self._h, key, len(key)) != 0:
-            raise OSError("kv_delete failed")
+        with self._lock:
+            if self._lib.kv_delete(self._h, key, len(key)) != 0:
+                raise OSError("kv_delete failed")
+            if self.fsync == "always":
+                self._lib.kv_flush(self._h)
 
     def has(self, key: bytes) -> bool:
-        return bool(self._lib.kv_has(self._h, key, len(key)))
+        with self._lock:
+            return bool(self._lib.kv_has(self._h, key, len(key)))
+
+    def write_batch(self, batch):
+        """Atomic commit of a kv.WriteBatch — the same BEGIN/COMMIT
+        marker grammar as FileKV (the two stores replay each other's
+        batches).  Fires the ``kv.commit`` crash point once before the
+        native call: the C side is a single append, so the per-record
+        crash-point matrix is FileKV's to enumerate."""
+        import struct as _struct
+
+        from .. import faultinject as FI
+        from .kv import _TOMB
+
+        ops = batch.ops
+        if not ops:
+            return
+        FI.fire("kv.commit", key=self.path)
+        out = bytearray()
+        for key, value in ops:
+            if value is None:
+                out += _struct.pack("<II", len(key), _TOMB) + key
+            else:
+                out += _struct.pack("<II", len(key), len(value))
+                out += key + value
+        with self._lock:
+            if self._lib.kv_write_batch(self._h, bytes(out), len(out),
+                                        len(ops)) != 0:
+                raise OSError("kv_write_batch failed")
 
     def flush(self):
-        self._lib.kv_flush(self._h)
+        with self._lock:
+            self._lib.kv_flush(self._h)
 
     def compact(self):
-        if self._lib.kv_compact(self._h) != 0:
-            raise OSError("kv_compact failed")
+        with self._lock:
+            if self._lib.kv_compact(self._h) != 0:
+                raise OSError("kv_compact failed")
 
     def close(self):
-        if self._h:
-            self._lib.kv_close(self._h)
-            self._h = None
+        with self._lock:
+            if self._h:
+                self._lib.kv_flush(self._h)
+                self._lib.kv_close(self._h)
+                self._h = None
+
+    @property
+    def closed(self) -> bool:
+        return not self._h
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def __len__(self):
-        return int(self._lib.kv_len(self._h))
+        with self._lock:
+            return int(self._lib.kv_len(self._h))
 
     def __del__(self):
         try:

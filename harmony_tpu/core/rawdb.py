@@ -33,6 +33,8 @@ _RECEIPTS = b"r"        # r || num(8) -> encoded receipt list
 _RECEIPT_IDX = b"R"     # R || tx_hash -> num(8) (lookup index)
 _CX = b"x"              # x || to_shard(4) || num(8) -> outgoing cx blob
 _CX_SPENT = b"X"        # X || from_shard(4) || num(8) -> spent marker
+_LAST_SIGNED = b"V"     # V || bls_pubkey -> last-signed vote record
+_VC_WATERMARK = b"W"    # W || bls_pubkey -> highest view-change signed
 
 
 # -- codecs -----------------------------------------------------------------
@@ -237,17 +239,20 @@ def read_canonical_hash(db, num: int) -> bytes | None:
     return db.get(_num_key(_CANON, num))
 
 
-def delete_canonical(db, num: int):
+def delete_canonical(db, num: int, w=None):
     """Drop block ``num`` from the canonical chain (revert tooling);
-    the hash->number index entry goes with it."""
+    the hash->number index entry goes with it.  ``w`` is the write
+    target (a WriteBatch staging an atomic revert); reads always come
+    from ``db``."""
+    w = db if w is None else w
     h = db.get(_num_key(_CANON, num))
     if h is not None:
-        db.delete(_NUM_BY_HASH + h)
-    db.delete(_num_key(_CANON, num))
-    db.delete(_num_key(_HEADER, num))
-    db.delete(_num_key(_BODY, num))
-    db.delete(_num_key(_COMMIT_SIG, num))
-    db.delete(_RECEIPTS + _enc_int(num))
+        w.delete(_NUM_BY_HASH + h)
+    w.delete(_num_key(_CANON, num))
+    w.delete(_num_key(_HEADER, num))
+    w.delete(_num_key(_BODY, num))
+    w.delete(_num_key(_COMMIT_SIG, num))
+    w.delete(_RECEIPTS + _enc_int(num))
 
 
 def read_block_number(db, block_hash: bytes) -> int | None:
@@ -438,3 +443,55 @@ def write_shard_state(db, epoch: int, state):
 def read_shard_state(db, epoch: int):
     blob = db.get(_num_key(_SHARD_STATE, epoch))
     return decode_shard_state(blob) if blob else None
+
+
+# -- durable consensus safety state -----------------------------------------
+#
+# The last vote each local BLS key signed, written BEFORE the vote
+# leaves the node (consensus/safety.py): a restarted validator reloads
+# it and can neither double-sign the same (height, view) with a
+# different hash nor re-enter a view it already signed past.  The
+# reference stores the equivalent in consensus' FBFT log; we keep it in
+# the shard DB so kill -9 + reopen recovers it with the chain.
+
+def write_last_signed(db, pubkey: bytes, block_num: int, view_id: int,
+                      phase: int, block_hash: bytes):
+    db.put(
+        _LAST_SIGNED + pubkey,
+        block_num.to_bytes(8, "little") + view_id.to_bytes(8, "little")
+        + phase.to_bytes(1, "little") + block_hash,
+    )
+
+
+def read_last_signed(db, pubkey: bytes):
+    """-> (block_num, view_id, phase, block_hash) or None."""
+    blob = db.get(_LAST_SIGNED + pubkey)
+    if blob is None or len(blob) < 17:
+        return None
+    return (
+        int.from_bytes(blob[0:8], "little"),
+        int.from_bytes(blob[8:16], "little"),
+        blob[16],
+        blob[17:],
+    )
+
+
+def write_vc_watermark(db, pubkey: bytes, block_num: int, view_id: int):
+    """Highest view this key has signed a VIEWCHANGE for (kept apart
+    from the vote record: a VC signature must never overwrite the
+    memory of WHAT was voted at a view)."""
+    db.put(
+        _VC_WATERMARK + pubkey,
+        block_num.to_bytes(8, "little") + view_id.to_bytes(8, "little"),
+    )
+
+
+def read_vc_watermark(db, pubkey: bytes):
+    """-> (block_num, view_id) or None."""
+    blob = db.get(_VC_WATERMARK + pubkey)
+    if blob is None or len(blob) < 16:
+        return None
+    return (
+        int.from_bytes(blob[0:8], "little"),
+        int.from_bytes(blob[8:16], "little"),
+    )

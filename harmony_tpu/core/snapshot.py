@@ -161,21 +161,30 @@ def import_snapshot(chain, path: str, trust: bool = False) -> int:
         )
 
     with chain._insert_lock:
+        # header + proof + state + head move in ONE atomic batch: a
+        # crash mid-import must leave the store exactly as damaged as
+        # before, never half-restored (same discipline as adopt_state)
+        from .kv import WriteBatch, commit_batch
+
+        batch = WriteBatch()
         if local is None:
-            chain.db.put(
+            batch.put(
                 rawdb._num_key(rawdb._HEADER, num),
                 rawdb.encode_header(header),
             )
-            chain.db.put(rawdb._num_key(rawdb._CANON, num), header.hash())
-            chain.db.put(
+            batch.put(rawdb._num_key(rawdb._CANON, num), header.hash())
+            batch.put(
                 rawdb._NUM_BY_HASH + header.hash(),
                 num.to_bytes(8, "little"),
             )
         if proof:
-            rawdb.write_commit_sig(chain.db, num, proof)
-        rawdb.write_state(chain.db, header.root, state_blob)
-        if num >= chain.head_number:
-            rawdb.write_head_number(chain.db, num)
+            rawdb.write_commit_sig(batch, num, proof)
+        rawdb.write_state(batch, header.root, state_blob)
+        moves_head = num >= chain.head_number
+        if moves_head:
+            rawdb.write_head_number(batch, num)
+        commit_batch(chain.db, batch)
+        if moves_head:
             chain._head_num = num
             chain._state = state
             chain._committee_cache.clear()

@@ -353,13 +353,29 @@ class StateDB:
     @classmethod
     def deserialize(cls, data: bytes) -> "StateDB":
         r = Reader(data)
-        n = r.int_(4)
+        n = _checked_count(r, 4)
         accounts = {}
         for _ in range(n):
             addr = r.bytes_()
             blob = r.bytes_()
             accounts[addr] = _decode_account(blob)
         return cls(accounts)
+
+
+def _checked_count(r: Reader, width: int) -> int:
+    """A length-prefixed element count, REJECTED when it cannot fit in
+    the remaining bytes (each element consumes >= 1 byte).  The Reader
+    reads silently past EOF (empty slices), so a corrupt blob's bogus
+    count would otherwise spin a billion no-op iterations — recovery-
+    on-open feeds crash-damaged blobs straight into this decoder and
+    must get a ValueError, never a wedge."""
+    n = r.int_(width)
+    if n > len(r.view) - r.off:
+        raise ValueError(
+            f"implausible element count {n} with "
+            f"{len(r.view) - r.off} bytes left"
+        )
+    return n
 
 
 def _decode_account(blob: bytes) -> Account:
@@ -370,14 +386,15 @@ def _decode_account(blob: bytes) -> Account:
     validator = None
     if has_val:
         address = r.bytes_()
-        keys = [r.bytes_() for _ in range(r.int_(4))]
+        keys = [r.bytes_() for _ in range(_checked_count(r, 4))]
         rates = [r.big_() for _ in range(5)]
         delegations = []
-        for _ in range(r.int_(4)):
+        for _ in range(_checked_count(r, 4)):
             delegator = r.bytes_()
             amount = r.big_()
             reward = r.big_()
-            undel = [(r.big_(), r.int_()) for _ in range(r.int_(4))]
+            undel = [(r.big_(), r.int_())
+                     for _ in range(_checked_count(r, 4))]
             delegations.append(
                 Delegation(delegator, amount, undel, reward)
             )
@@ -392,7 +409,7 @@ def _decode_account(blob: bytes) -> Account:
     code, storage = b"", {}
     if not r.eof() and r.int_(1):
         code = r.bytes_()
-        for _ in range(r.int_(4)):
+        for _ in range(_checked_count(r, 4)):
             slot = r.bytes_()
             storage[slot] = r.big_()
     return Account(balance, nonce, validator, code, storage)

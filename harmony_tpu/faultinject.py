@@ -29,6 +29,10 @@ Wired injection points:
     p2p.stream       — p2p/stream.py SyncClient, entry of every request
                        (key = "host:port" of the peer)
     webhook.post     — webhooks.py, each HTTP POST attempt
+    kv.commit        — core/kv.py FileKV.write_batch (key = the store's
+                       path): before the BEGIN marker, before every
+                       record, before the COMMIT marker — the storage
+                       crash-point matrix tools/crash_sweep.py walks
 
 Always ``reset()`` in test teardown: the registry is process-global.
 """
@@ -162,6 +166,18 @@ def hits(point: str) -> int:
     counted only while the registry is armed)."""
     with _lock:
         return _hits.get(point, 0)
+
+
+def fired(point: str, key=None) -> int:
+    """Faults actually DELIVERED at ``point`` (summed over armed rules;
+    ``key`` narrows to rules bound to that key).  Lets a scenario
+    script wait for 'the crash point has fired on THIS node' instead
+    of guessing with sleeps."""
+    with _lock:
+        return sum(
+            r.fired for r in _rules.get(point, ())
+            if key is None or r.key == key
+        )
 
 
 def _raise(exc, point: str):

@@ -33,6 +33,12 @@ from ..consensus.messages import (
     sign_message,
 )
 from ..consensus.quorum import Decider, Policy
+from ..consensus.safety import (
+    PHASE_COMMIT,
+    PHASE_PREPARE,
+    PHASE_VIEWCHANGE,
+    SafetyStore,
+)
 from ..consensus.sender import MessageSender
 from ..consensus.view_change import (
     ViewChangeCollector,
@@ -84,6 +90,12 @@ class Node:
         self.new_views_adopted = 0  # NEWVIEW adoptions (chaos metrics)
         self.webhooks = registry.get("webhooks")
         self.pending_double_signs: list = []  # evidence for proposals
+        # durable last-signed-view state: written through the chain DB
+        # BEFORE any vote leaves this node, reloaded here on restart —
+        # a hard-killed validator can neither double-sign its last
+        # round nor re-enter a view it already signed past
+        self.safety = SafetyStore(self.chain.db)
+        self.safety.load_keys([k.pub.bytes for k in keys])
         self._vc = 0  # view changes since last commit
         self.in_view_change = False
         self.phase_timeout = 27.0  # reference: consensus/config.go:10
@@ -98,6 +110,7 @@ class Node:
         self.ahead_threshold = 4
         self._syncing = False
         self._sync_done = threading.Event()
+        self._sync_thread = None  # live downloader thread (join on stop)
         self.sync_spinups = 0
         # preCommitAndPropose analog (consensus_v2.go:559-635): the
         # leader proposes the NEXT block immediately after broadcasting
@@ -147,6 +160,15 @@ class Node:
                 lambda _t, payload, _f: self.cx_pool.add_batch(payload),
             )
         self._new_round()
+        # restart fast-forward, applied ONCE: rejoin the round at the
+        # highest view this node's keys voted OR view-changed at
+        # (durable SafetyStore records) instead of re-entering the
+        # storm from view 1.  A LIVE node's floor (in _new_round) uses
+        # votes only — the watermark belongs to the restart path.
+        floor = self.safety.restart_floor(self.block_num)
+        if floor > self.view_id:
+            self._vc += floor - self.view_id
+            self._new_round()
 
     # -- committee / role ---------------------------------------------------
 
@@ -207,6 +229,21 @@ class Node:
         # every node derives the same view id from the committed head
         # plus its local view-change count (reset on commit)
         self.view_id = head.view_id + 1 + self._vc
+        # STRICT view monotonicity per height: never re-enter a view
+        # this node already voted (or announced) in.  FBFT's view
+        # derivation legitimately cycles back — _vc resets on sync
+        # rejoin — but the SafetyStore keeps only the LAST vote per
+        # key, so on a re-entered view a leader would re-propose
+        # fresh (new timestamp = new hash) while slower peers still
+        # hold that view's old record and rightly withhold: with
+        # records scattered across visits, NO re-entered view can
+        # assemble quorum again (the rolling-restart scenario wedged
+        # at one height for 280 s on exactly that).  Votes only — the
+        # VC watermark races ahead of adoptable views in a storm.
+        voted = self.safety.min_view(self.block_num)
+        if voted and voted + 1 > self.view_id:
+            self._vc += voted + 1 - self.view_id
+            self.view_id = voted + 1
         committee = self.committee()
         # only keys holding a slot in THIS round's committee may sign:
         # a multi-key operator whose extra key is not (or no longer)
@@ -357,6 +394,19 @@ class Node:
                 view_id=self.view_id, vrf=vrf, incoming_receipts=incoming
             )
         block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
+        # the announce carries the leader's own prepare signature:
+        # durably record it first — a restarted leader must not
+        # propose a DIFFERENT block at a (height, view) it already
+        # announced (leader-side equivocation after recovery)
+        if self._round_keys and not self.safety.record(
+            [k.pub.bytes for k in self._round_keys],
+            block.block_num, self.view_id, PHASE_PREPARE, block.hash(),
+        ):
+            self.log.warn(
+                "proposal withheld by safety store",
+                block=block.block_num, view=self.view_id,
+            )
+            return None
         self._pending_block = block
         self._proposed = True
         self._last_propose = time.monotonic()
@@ -400,6 +450,10 @@ class Node:
         def run():
             try:
                 for _ in range(1024):  # bounded: each pass is a batch
+                    if self._stop.is_set():
+                        break  # a stopped node must not keep WRITING
+                        # to its chain store (a hard-kill + restart
+                        # would otherwise race two writers on one file)
                     res = downloader.sync_once()
                     if res.caught_up:
                         break
@@ -408,7 +462,8 @@ class Node:
             finally:
                 self._sync_done.set()
 
-        threading.Thread(target=run, daemon=True).start()
+        self._sync_thread = threading.Thread(target=run, daemon=True)
+        self._sync_thread.start()
 
     def _finish_sync_if_done(self):
         """Pump-side completion: re-derive the round from the synced
@@ -619,6 +674,17 @@ class Node:
         self.validator.cfg.payload_view_id = block.header.view_id
         if not self._round_keys:
             return  # observer this epoch: follow, never vote
+        # durable double-sign guard, written BEFORE the vote leaves:
+        # survives a hard kill where _announce_voted does not
+        if not self.safety.record(
+            [k.pub.bytes for k in self._round_keys],
+            msg.block_num, self.view_id, PHASE_PREPARE, block.hash(),
+        ):
+            self.log.warn(
+                "prepare vote withheld by safety store",
+                block=msg.block_num, view=self.view_id,
+            )
+            return
         vote = self.validator.on_announce(msg)
         self._broadcast(vote)
         self.log.info(
@@ -651,7 +717,15 @@ class Node:
                 # leader self-commits with its own keys
                 # (reference: threshold.go:53-69)
                 commit_vote = self.validator.on_prepared(prepared)
-                if commit_vote is not None:
+                # the record must carry the view the signed bytes BIND
+                # (cfg.commit_view_id — a re-proposal's payload keeps
+                # its ORIGINAL view), or equivocation across a view-
+                # change re-proposal would slip past the guard
+                if commit_vote is not None and self.safety.record(
+                    [k.pub.bytes for k in self._round_keys],
+                    self.block_num, self.validator.cfg.commit_view_id,
+                    PHASE_COMMIT, block_hash,
+                ):
                     self.leader.on_commit(commit_vote)
         if self._sent_prepared and not self._sent_committed:
             committed = self.leader.try_committed(block_hash)
@@ -745,6 +819,19 @@ class Node:
             return  # observer: cannot cast a commit vote
         vote = self.validator.on_prepared(msg)
         if vote is not None:
+            # record the view the commit payload BINDS (a re-proposal
+            # signs its original view, not the round view) — the
+            # double-sign guard must compare what was actually signed
+            if not self.safety.record(
+                [k.pub.bytes for k in self._round_keys],
+                msg.block_num, self.validator.cfg.commit_view_id,
+                PHASE_COMMIT, msg.block_hash,
+            ):
+                self.log.warn(
+                    "commit vote withheld by safety store",
+                    block=msg.block_num, view=self.view_id,
+                )
+                return
             # remember the prepared proof: a view change must carry it
             # (M1) so the block survives the leader's failure
             self._prepared_proof = msg.payload
@@ -928,6 +1015,19 @@ class Node:
         prepared_hash = None
         if self._prepared_proof is not None and self._pending_block is not None:
             prepared_hash = self._pending_block.hash()
+        # a VC signature is a durable promise to leave the old view:
+        # recorded before broadcast so a restarted node's round view
+        # fast-forwards past it (_new_round's floor)
+        if not self.safety.record(
+            [k.pub.bytes for k in self._round_keys],
+            self.block_num, new_view, PHASE_VIEWCHANGE,
+            prepared_hash or bytes(32),
+        ):
+            self.log.warn(
+                "view-change vote withheld by safety store",
+                block=self.block_num, new_view=new_view,
+            )
+            return
         vc = construct_viewchange(
             self._round_keys, new_view, self.block_num,
             prepared_hash, self._prepared_proof,

@@ -208,6 +208,15 @@ class InProcessNetwork:
             self._hosts.append(h)
         return h
 
+    def remove(self, host) -> None:
+        """Detach a host from the hub (a killed node's process would
+        take its sockets with it; the in-process analog must stop
+        delivering to — and accepting validation verdicts from — the
+        dead node's object, or a restart under the same name would
+        leave two receivers)."""
+        with self._lock:
+            self._hosts = [h for h in self._hosts if h is not host]
+
     def route(self, topic: str, payload: bytes, frm: str):
         if len(payload) > MAX_MESSAGE_BYTES:
             raise ValueError("message exceeds 2 MB cap")

@@ -8,6 +8,12 @@
 // Record format (little-endian):
 //   [klen u32][vlen u32 | 0xFFFFFFFF = tombstone][key][value]
 //
+// Atomic commit batches (same grammar as the Python twin): records
+// bracketed by BEGIN = [0xFFFFFFFE][count] and COMMIT = [0xFFFFFFFD]
+// [count] markers; replay applies a batch only when its COMMIT marker
+// (with the matching count) is on disk — a crash anywhere inside the
+// batch makes the whole batch invisible on reopen.
+//
 // C ABI: every function is kv_*; buffers returned by kv_get are owned
 // by the store and valid until the next call on the same handle
 // (single-threaded per handle, like the Python twin).
@@ -25,12 +31,16 @@
 namespace {
 
 constexpr uint32_t kTomb = 0xFFFFFFFFu;
+constexpr uint32_t kBatchBegin = 0xFFFFFFFEu;
+constexpr uint32_t kBatchCommit = 0xFFFFFFFDu;
+constexpr uint32_t kKlenMax = 0xFFFFFFF0u;  // larger = corrupt header
 
 struct Store {
   std::FILE* f = nullptr;
   std::string path;
   std::unordered_map<std::string, std::pair<uint64_t, uint32_t>> index;
   std::vector<uint8_t> last_value;  // buffer handed to callers
+  int fsync_batch = 0;  // kv_config: fsync on every batch commit
 
   ~Store() {
     if (f) std::fclose(f);
@@ -65,32 +75,72 @@ bool replay(Store* s) {
   const uint64_t file_size = static_cast<uint64_t>(std::ftell(s->f));
   std::fseek(s->f, 0, SEEK_SET);
   uint64_t pos = 0;
+  bool in_batch = false;
+  uint64_t batch_start = 0;   // offset of the open batch's BEGIN marker
+  uint32_t batch_count = 0;
+  // records staged inside the open batch: (key, voff, vlen);
+  // voff == UINT64_MAX marks a tombstone
+  std::vector<std::tuple<std::string, uint64_t, uint32_t>> pending;
   std::vector<char> keybuf;
   for (;;) {
+    pos = static_cast<uint64_t>(std::ftell(s->f));
     uint8_t hdr[8];
     if (!read_exact(s->f, hdr, 8)) break;
     const uint32_t klen = load_u32(hdr);
     const uint32_t vlen = load_u32(hdr + 4);
+    if (klen == kBatchBegin) {
+      if (in_batch) break;  // nested BEGIN: corrupt
+      in_batch = true;
+      batch_start = pos;
+      batch_count = vlen;
+      pending.clear();
+      continue;
+    }
+    if (klen == kBatchCommit) {
+      if (!in_batch || vlen != pending.size() ||
+          batch_count != pending.size()) {
+        break;  // marker without its batch, or count mismatch
+      }
+      for (auto& [key, voff, vl] : pending) {
+        if (voff == UINT64_MAX) {
+          s->index.erase(key);
+        } else {
+          s->index[std::move(key)] = {voff, vl};
+        }
+      }
+      in_batch = false;
+      pending.clear();
+      continue;
+    }
+    if (klen >= kKlenMax) break;  // implausible key length
     if (pos + 8 + klen > file_size) break;  // torn/corrupt key length
     keybuf.resize(klen);
     if (klen && !read_exact(s->f, keybuf.data(), klen)) break;
     std::string key(keybuf.data(), klen);
     if (vlen == kTomb) {
-      s->index.erase(key);
-      pos = static_cast<uint64_t>(std::ftell(s->f));
+      if (in_batch) {
+        pending.emplace_back(std::move(key), UINT64_MAX, 0);
+      } else {
+        s->index.erase(key);
+      }
       continue;
     }
     const uint64_t voff = static_cast<uint64_t>(std::ftell(s->f));
     if (voff + vlen > file_size) break;  // torn value
     std::fseek(s->f, static_cast<long>(vlen), SEEK_CUR);
-    s->index[std::move(key)] = {voff, vlen};
-    pos = voff + vlen;
+    if (in_batch) {
+      pending.emplace_back(std::move(key), voff, vlen);
+    } else {
+      s->index[std::move(key)] = {voff, vlen};
+    }
   }
-  // drop any torn tail (pos <= file_size, so this only ever shrinks),
-  // then position for appends
+  // drop everything from the failure point — from the BEGIN marker if
+  // the failure is inside an open batch (the un-committed batch must
+  // be invisible to appends too); never grows the file
+  const uint64_t cut = in_batch ? batch_start : pos;
   std::fflush(s->f);
-  if (pos < file_size &&
-      truncate(s->path.c_str(), static_cast<off_t>(pos)) != 0) {
+  if (cut < file_size &&
+      truncate(s->path.c_str(), static_cast<off_t>(cut)) != 0) {
     // non-fatal: reads still consistent, appends go after the tear
   }
   std::freopen(s->path.c_str(), "r+b", s->f);
@@ -177,6 +227,81 @@ int kv_delete(void* h, const uint8_t* key, uint32_t klen) {
   return append_record(s, key, klen, nullptr, 0, true) ? 0 : -1;
 }
 
+// Atomic batch commit.  `payload` is `count` concatenated records in
+// the standard on-disk format (tombstones via vlen = 0xFFFFFFFF); the
+// store brackets them with BEGIN/COMMIT markers, optionally fsyncs
+// (kv_config), and applies them to the index only after the marker
+// write succeeded.  On ANY failure the log is truncated back to the
+// batch start — all-or-nothing on disk AND in memory.
+int kv_write_batch(void* h, const uint8_t* payload, uint64_t payload_len,
+                   uint32_t count) {
+  auto* s = static_cast<Store*>(h);
+  std::fseek(s->f, 0, SEEK_END);
+  const uint64_t start = static_cast<uint64_t>(std::ftell(s->f));
+
+  // parse + bounds-check the payload BEFORE writing anything
+  std::vector<std::tuple<std::string, uint64_t, uint32_t>> staged;
+  uint64_t off = 0;
+  while (off < payload_len) {
+    if (off + 8 > payload_len) return -1;
+    const uint32_t klen = load_u32(payload + off);
+    const uint32_t vlen = load_u32(payload + off + 4);
+    if (klen >= kKlenMax) return -1;
+    if (off + 8 + klen > payload_len) return -1;
+    std::string key(reinterpret_cast<const char*>(payload + off + 8), klen);
+    off += 8 + klen;
+    if (vlen == kTomb) {
+      staged.emplace_back(std::move(key), UINT64_MAX, 0);
+      continue;
+    }
+    if (off + vlen > payload_len) return -1;
+    // voff is relative for now; rebased after the BEGIN marker lands
+    staged.emplace_back(std::move(key), off, vlen);
+    off += vlen;
+  }
+  if (staged.size() != count) return -1;
+
+  uint8_t hdr[8];
+  store_u32(hdr, kBatchBegin);
+  store_u32(hdr + 4, count);
+  bool ok = std::fwrite(hdr, 1, 8, s->f) == 8;
+  if (ok && payload_len) {
+    ok = std::fwrite(payload, 1, payload_len, s->f) == payload_len;
+  }
+  if (ok) {
+    store_u32(hdr, kBatchCommit);
+    store_u32(hdr + 4, count);
+    ok = std::fwrite(hdr, 1, 8, s->f) == 8;
+  }
+  if (!ok) {
+    std::fflush(s->f);
+    truncate(s->path.c_str(), static_cast<off_t>(start));
+    std::freopen(s->path.c_str(), "r+b", s->f);
+    std::fseek(s->f, 0, SEEK_END);
+    return -1;
+  }
+  if (s->fsync_batch) {
+    std::fflush(s->f);
+    fsync(fileno(s->f));
+  }
+  const uint64_t base = start + 8;  // payload begins after BEGIN marker
+  for (auto& [key, voff, vlen] : staged) {
+    if (voff == UINT64_MAX) {
+      s->index.erase(key);
+    } else {
+      s->index[std::move(key)] = {base + voff, vlen};
+    }
+  }
+  return 0;
+}
+
+// Store configuration: currently one knob, fsync-on-batch-commit
+// (0 = OS-buffered, 1 = durable batch commits).
+int kv_config(void* h, int fsync_batch) {
+  static_cast<Store*>(h)->fsync_batch = fsync_batch ? 1 : 0;
+  return 0;
+}
+
 int kv_has(void* h, const uint8_t* key, uint32_t klen) {
   auto* s = static_cast<Store*>(h);
   return s->index.count(
@@ -190,7 +315,13 @@ uint64_t kv_len(void* h) {
 }
 
 int kv_flush(void* h) {
-  return std::fflush(static_cast<Store*>(h)->f) == 0 ? 0 : -1;
+  // flush() is the DURABILITY call (FileKV.flush os.fsync's): stdio
+  // flush alone only reaches the page cache and would silently break
+  // the SafetyStore's written-durably-before-broadcast guarantee on
+  // the native (default) path
+  auto* s = static_cast<Store*>(h);
+  if (std::fflush(s->f) != 0) return -1;
+  return fsync(fileno(s->f)) == 0 ? 0 : -1;
 }
 
 // Rewrite live records; reclaims tombstones and stale puts.

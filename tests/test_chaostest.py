@@ -141,6 +141,7 @@ def test_scenario_registry_names_and_shape():
     assert set(SCENARIOS) == {
         "view_change_storm", "epoch_election_rotation",
         "cross_shard_partition", "validator_churn", "sidecar_flap",
+        "leader_kill_restart", "rolling_restart",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):

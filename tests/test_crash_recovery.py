@@ -1,0 +1,420 @@
+"""Crash-consistent storage + restart recovery (ISSUE 12 tentpole):
+atomic per-block commit batches, recovery-on-open head rollback, the
+kv.commit crash-point matrix, and the durable last-signed-view safety
+store that keeps a restarted validator from double-signing."""
+
+import pytest
+
+from harmony_tpu import faultinject as FI
+from harmony_tpu.consensus.safety import (
+    PHASE_COMMIT,
+    PHASE_PREPARE,
+    PHASE_VIEWCHANGE,
+    SafetyStore,
+)
+from harmony_tpu.core import rawdb
+from harmony_tpu.core.blockchain import Blockchain, ChainError
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import FileKV, MemKV, WriteBatch
+from harmony_tpu.node.worker import Worker
+
+CHAIN_ID = 2
+
+
+@pytest.fixture(autouse=True)
+def _fi_clean():
+    FI.reset()
+    yield
+    FI.reset()
+
+
+def _proof(chain, num):
+    committee = chain.committee_for_epoch(chain.epoch_of(num))
+    return b"\x01" * 96 + b"\xff" * ((len(committee) + 7) >> 3)
+
+
+def _grow(chain, n, with_proofs=True):
+    worker = Worker(chain, None)
+    blocks = []
+    for _ in range(n):
+        block = worker.propose_block(view_id=chain.head_number + 1)
+        sigs = [_proof(chain, block.block_num)] if with_proofs else None
+        assert chain.insert_chain(
+            [block], commit_sigs=sigs, verify_seals=False
+        ) == 1
+        blocks.append(block)
+    return blocks
+
+
+def _open(path, genesis, **kw):
+    kw.setdefault("blocks_per_epoch", 16)
+    return Blockchain(FileKV(path), genesis, **kw)
+
+
+# -- atomic block commits ----------------------------------------------------
+
+
+def test_block_insert_is_one_atomic_batch(tmp_path):
+    """A crash at ANY kv.commit point of an insert leaves the previous
+    head fully intact on reopen — never a block without its state,
+    proof, or head pointer."""
+    path = str(tmp_path / "chain.kv")
+    genesis, _, _ = dev_genesis()
+    chain = _open(path, genesis)
+    _grow(chain, 2)
+    chain.db.close()
+
+    # enumerate this insert's crash points with a counting-only rule
+    FI.arm("kv.commit", key="__none__", after=10**9)
+    chain = _open(path, genesis)
+    block = Worker(chain, None).propose_block(view_id=3)
+    before = FI.hits("kv.commit")
+    chain.insert_chain([block], commit_sigs=[_proof(chain, 3)],
+                       verify_seals=False)
+    points = FI.hits("kv.commit") - before
+    assert points >= 3  # BEGIN + records + COMMIT at minimum
+    chain.db.close()
+
+    for k in range(points):
+        p = str(tmp_path / f"fp{k}.kv")
+        import shutil
+
+        shutil.copyfile(path, p)
+        c = Blockchain(FileKV(p), genesis, blocks_per_epoch=16)
+        c.revert_to(2)
+        blk = Worker(c, None).propose_block(view_id=3)
+        FI.reset()
+        FI.arm("kv.commit", key=p, after=k, times=1)
+        with pytest.raises(FI.FaultInjected):
+            c.insert_chain([blk], commit_sigs=[_proof(c, 3)],
+                           verify_seals=False)
+        FI.reset()
+        # abandon without close (unbuffered writes = SIGKILL state)
+        r = Blockchain(FileKV(p), genesis, blocks_per_epoch=16,
+                       require_commit_sigs=True)
+        assert r.head_number == 2
+        assert r.current_header() is not None
+        assert r.read_commit_sig(2) is not None
+        # zero manual repair: the block inserts cleanly after recovery
+        assert r.insert_chain([blk], commit_sigs=[_proof(r, 3)],
+                              verify_seals=False) == 1
+        r.db.close()
+
+
+def test_reopen_after_clean_insert(tmp_path):
+    path = str(tmp_path / "chain.kv")
+    genesis, _, _ = dev_genesis()
+    chain = _open(path, genesis)
+    blocks = _grow(chain, 3)
+    chain.db.close()
+    re = _open(path, genesis, require_commit_sigs=True)
+    assert re.head_number == 3
+    assert re.current_header().hash() == blocks[-1].hash()
+    assert re.recovered_blocks == 0
+    re.db.close()
+
+
+# -- recovery-on-open --------------------------------------------------------
+
+
+def test_torn_head_rolls_back_on_open(tmp_path):
+    """A pre-batch-era torn commit (head pointer advanced, block
+    records missing) must roll back to the last whole block instead of
+    crashing or serving the torn head."""
+    path = str(tmp_path / "chain.kv")
+    genesis, _, _ = dev_genesis()
+    chain = _open(path, genesis)
+    _grow(chain, 3)
+    # simulate the legacy tear: head says 4, but only a header made it
+    hdr = chain.current_header()
+    fake = rawdb.encode_header(hdr)
+    chain.db.put(b"h" + (4).to_bytes(8, "little"), fake)
+    rawdb.write_head_number(chain.db, 4)
+    chain.db.close()
+
+    re = _open(path, genesis, require_commit_sigs=True)
+    assert re.head_number == 3
+    assert re.recovered_blocks == 1
+    # the rollback is durable: a second reopen is clean
+    re.db.close()
+    re2 = _open(path, genesis, require_commit_sigs=True)
+    assert re2.head_number == 3
+    assert re2.recovered_blocks == 0
+    re2.db.close()
+
+
+def test_missing_commit_sig_rolls_back_when_required(tmp_path):
+    path = str(tmp_path / "chain.kv")
+    genesis, _, _ = dev_genesis()
+    chain = _open(path, genesis)
+    _grow(chain, 3)
+    chain.db.delete(b"s" + (3).to_bytes(8, "little"))
+    chain.db.close()
+    # consensus-shaped chains require the proof: roll back
+    re = _open(path, genesis, require_commit_sigs=True)
+    assert re.head_number == 2
+    re.db.close()
+    # proof-less test chains do not (engine=None default)
+    p2 = str(tmp_path / "chain2.kv")
+    c2 = _open(p2, genesis)
+    _grow(c2, 2, with_proofs=False)
+    c2.db.close()
+    re2 = _open(p2, genesis)
+    assert re2.head_number == 2
+    re2.db.close()
+
+
+def test_pruned_state_still_raises_missing_state(tmp_path):
+    """A WHOLE block whose state blob is absent is a pruned/snapshot
+    store, not a tear: reopen must raise the classic error, never
+    destroy block records by rolling back through them."""
+    path = str(tmp_path / "chain.kv")
+    genesis, _, _ = dev_genesis()
+    chain = _open(path, genesis)
+    _grow(chain, 2)
+    rawdb.delete_state(chain.db, chain.current_header().root)
+    chain.db.close()
+    with pytest.raises(ChainError, match="missing state"):
+        _open(path, genesis)
+
+
+def test_corrupt_state_blob_rolls_back(tmp_path):
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.core.types import Transaction
+
+    path = str(tmp_path / "chain.kv")
+    genesis, ecdsa_keys, _ = dev_genesis()
+    chain = _open(path, genesis)
+    # empty dev blocks share one state root — the blocks need distinct
+    # roots so corrupting the HEAD's blob damages only the head
+    for n in range(2):
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        pool.add(Transaction(
+            nonce=n, gas_price=1, gas_limit=21_000, shard_id=0,
+            to_shard=0, to=b"\x2d" * 20, value=1 + n,
+        ).sign(ecdsa_keys[0], CHAIN_ID))
+        block = Worker(chain, pool).propose_block(
+            view_id=chain.head_number + 1
+        )
+        assert chain.insert_chain(
+            [block], commit_sigs=[_proof(chain, block.block_num)],
+            verify_seals=False,
+        ) == 1
+    h1, h2 = chain.header_by_number(1), chain.header_by_number(2)
+    assert h1.root != h2.root
+    chain.db.put(b"S" + h2.root, b"\xff\xff\xff\xffgarbage")
+    chain.db.close()
+    re = _open(path, genesis, require_commit_sigs=True)
+    assert re.head_number == 1
+    re.db.close()
+
+
+def test_revert_is_atomic_and_unspends_cx(tmp_path):
+    """revert_to stages ALL deletes + the head move into one batch —
+    and un-marks consumed cx batches so a re-synced block's proofs
+    are not misread as double spends (the rawdb revert tooling)."""
+    from harmony_tpu.core.genesis import Genesis
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.core.types import Transaction
+    from harmony_tpu.node.cross_shard import export_receipts
+
+    g0, ecdsa_keys, _ = dev_genesis(shard_id=0)
+    g1 = Genesis(config=g0.config, shard_id=1, alloc=dict(g0.alloc),
+                 committee=list(g0.committee))
+    c0 = Blockchain(MemKV(), g0, blocks_per_epoch=16)
+    c1 = Blockchain(MemKV(), g1, blocks_per_epoch=16)
+    pool = TxPool(CHAIN_ID, 0, c0.state)
+    pool.add(Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0,
+        to_shard=1, to=b"\x0c" * 20, value=777,
+    ).sign(ecdsa_keys[0], CHAIN_ID))
+    b0 = Worker(c0, pool).propose_block(view_id=1)
+    assert c0.insert_chain([b0], verify_seals=False) == 1
+    proofs = export_receipts(c0, 1, shard_count=2)
+    b1 = Worker(c1, None).propose_block(
+        view_id=1, incoming_receipts=[proofs[1]]
+    )
+    assert c1.insert_chain([b1], verify_seals=False) == 1
+    assert rawdb.is_cx_spent(c1.db, 0, 1)
+    assert rawdb.cx_spender(c1.db, 0, 1) == 1
+    assert c1.state().balance(b"\x0c" * 20) == 777
+
+    assert c1.revert_to(0) == 1
+    assert not rawdb.is_cx_spent(c1.db, 0, 1)  # un-spent on revert
+    assert c1.head_number == 0
+    assert rawdb.read_header(c1.db, 1) is None
+    # the revert is the whole point: the SAME block re-inserts
+    assert c1.insert_chain([b1], verify_seals=False) == 1
+    assert rawdb.cx_spender(c1.db, 0, 1) == 1
+    assert c1.state().balance(b"\x0c" * 20) == 777
+
+
+# -- the durable safety store ------------------------------------------------
+
+
+def test_safety_store_rules():
+    db = MemKV()
+    s = SafetyStore(db)
+    pk = b"\x11" * 48
+    h_a, h_b = b"\xaa" * 32, b"\xbb" * 32
+
+    assert s.record([pk], 5, 6, PHASE_PREPARE, h_a)
+    # same (height, view), same hash: idempotent re-sign
+    assert s.may_sign(pk, 5, 6, PHASE_PREPARE, h_a)
+    # same (height, view), DIFFERENT hash: the double sign
+    assert not s.may_sign(pk, 5, 6, PHASE_PREPARE, h_b)
+    assert not s.record([pk], 5, 6, PHASE_COMMIT, h_b)
+    assert s.refused == 1
+    # commit on the SAME hash advances fine
+    assert s.record([pk], 5, 6, PHASE_COMMIT, h_a)
+    # OTHER views at the same height are ordinary FBFT view churn,
+    # not equivocation — a NEWVIEW quorum can form below this key's
+    # last view and its vote there must not be withheld
+    assert s.may_sign(pk, 5, 5, PHASE_PREPARE, h_b)
+    assert s.may_sign(pk, 5, 9, PHASE_PREPARE, h_b)
+    # stale height: refused; higher height: fine
+    assert not s.may_sign(pk, 4, 9, PHASE_PREPARE, h_b)
+    assert s.may_sign(pk, 6, 7, PHASE_PREPARE, h_b)
+    # a view-change FOR view 8 never conflicts with votes, raises the
+    # restart watermark, and never overwrites the vote record
+    assert s.record([pk], 5, 8, PHASE_VIEWCHANGE, bytes(32))
+    assert s.may_sign(pk, 5, 8, PHASE_PREPARE, h_b)
+    assert s.last(pk)[3] == h_a  # vote memory intact
+    assert s.watermark(pk) == (5, 8)
+    # live floor (view monotonicity) tracks VOTES only; the restart
+    # floor is strictly above the last vote and honors the watermark
+    assert s.min_view(5) == 6
+    assert s.restart_floor(5) == 8  # max(voted 6 + 1, watermark 8)
+    assert s.min_view(99) == 0
+
+
+def test_safety_store_survives_reopen(tmp_path):
+    path = str(tmp_path / "safety.kv")
+    db = FileKV(path)
+    s = SafetyStore(db)
+    pk = b"\x22" * 48
+    assert s.record([pk], 3, 4, PHASE_PREPARE, b"\xcc" * 32)
+    db.close()  # hard kill would be equivalent: puts are unbuffered
+
+    db2 = FileKV(path)
+    s2 = SafetyStore(db2)
+    s2.load_keys([pk])
+    assert s2.last(pk) == (3, 4, PHASE_PREPARE, b"\xcc" * 32)
+    assert not s2.may_sign(pk, 3, 4, PHASE_PREPARE, b"\xdd" * 32)
+    assert s2.min_view(3) == 4
+    db2.close()
+
+
+def test_restarted_validator_cannot_double_sign(tmp_path, monkeypatch):
+    """Node-level: a validator votes PREPARE for block A, is hard-
+    killed, restarts from the same data dir, and receives an
+    equivocating announce for block B at the SAME (height, view) — the
+    durable record must withhold the second vote."""
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    from harmony_tpu.consensus.messages import MsgType
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+
+    genesis, _, bls_keys = dev_genesis(n_keys=4)
+    path = str(tmp_path / "val.kv")
+    net = InProcessNetwork()
+
+    def build(host_name):
+        chain = Blockchain(FileKV(path), genesis, blocks_per_epoch=16)
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        reg = Registry(blockchain=chain, txpool=pool,
+                       host=net.host(host_name))
+        # a validator key that is NOT the view-1 leader slot
+        # (view 1 -> committee[1 % 4] = key 1 leads)
+        return Node(reg, PrivateKeys.from_keys([bls_keys[2]]))
+
+    # the view-1 leader proposes block A on ITS OWN chain replica
+    leader_chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    leader_pool = TxPool(CHAIN_ID, 0, leader_chain.state)
+    leader_reg = Registry(blockchain=leader_chain, txpool=leader_pool,
+                          host=net.host("leader"))
+    leader = Node(leader_reg, PrivateKeys.from_keys([bls_keys[1]]))
+
+    val = build("val")
+    block_a = leader.start_round_if_leader()
+    assert block_a is not None
+    assert val.process_pending() >= 1  # announce consumed
+    rec = val.safety.last(bls_keys[2].pub.bytes)
+    assert rec is not None
+    assert rec[:2] == (1, 1) and rec[3] == block_a.hash()
+
+    # hard kill: abandon the node, reopen the SAME file
+    val.stop()
+    val2 = build("val2")
+    assert val2.safety.last(bls_keys[2].pub.bytes)[3] == block_a.hash()
+
+    # an equivocating announce: different block, same (height, view).
+    # worker proposals differ by timestamp/coinbase ordering — force a
+    # distinct hash via leader_extra
+    from harmony_tpu.consensus.messages import (
+        FBFTMessage, encode_message, sign_message,
+    )
+    from harmony_tpu.node.ingress import MessageCategory, pack_envelope
+
+    block_b = Worker(leader_chain, None).propose_block(
+        view_id=1, leader_extra=b"equivocate"
+    )
+    assert block_b.hash() != block_a.hash()
+    announce_b = sign_message(FBFTMessage(
+        msg_type=MsgType.ANNOUNCE, view_id=1, block_num=1,
+        block_hash=block_b.hash(),
+        sender_pubkeys=[bls_keys[1].pub.bytes],
+        payload=b"", block=rawdb.encode_block(block_b, CHAIN_ID),
+    ), PrivateKeys.from_keys([bls_keys[1]]))
+    env = pack_envelope(
+        MessageCategory.CONSENSUS, int(MsgType.ANNOUNCE),
+        encode_message(announce_b),
+    )
+    # strict view monotonicity: the restarted node rejoined ABOVE the
+    # view it already voted in, so the equivocating view-1 announce is
+    # dropped at the view-mismatch gate — the double sign is prevented
+    # one layer before the record check even runs
+    assert val2.view_id == 2
+    val2._handle(env)
+    assert val2._announce_voted is None  # no vote left the node
+    # and the durable record still names block A at (1, view 1)
+    assert val2.safety.last(bls_keys[2].pub.bytes)[3] == block_a.hash()
+    # the record check itself also refuses (belt and braces): a forged
+    # same-view different-hash sign attempt is withheld
+    assert not val2.safety.may_sign(
+        bls_keys[2].pub.bytes, 1, 1, PHASE_PREPARE, block_b.hash()
+    )
+    val2.stop()
+    leader.stop()
+    val2.chain.db.close()
+
+
+def test_adopt_state_moves_head_and_state_together(tmp_path):
+    """Fast-sync completion: a crash between the state write and the
+    head move must never strand a head without state — they commit in
+    one batch."""
+    path = str(tmp_path / "fast.kv")
+    genesis, _, _ = dev_genesis()
+    src = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    blocks = _grow(src, 3)
+
+    dst = _open(path, genesis)
+    assert dst.insert_headers_fast(
+        blocks, commit_sigs=[_proof(src, b.block_num) for b in blocks],
+        verify_seals=False,
+    ) == 3
+    assert dst.head_number == 0  # head does not move on fast insert
+
+    FI.arm("kv.commit", key=path, after=1, times=1)
+    with pytest.raises(FI.FaultInjected):
+        dst.adopt_state(3, src.state_at(3))
+    FI.reset()
+    r = _open(path, genesis, require_commit_sigs=True)
+    assert r.head_number == 0  # neither state nor head moved
+    r.adopt_state(3, src.state_at(3))
+    assert r.head_number == 3
+    r.db.close()
+    dst.db.close()

@@ -1,11 +1,14 @@
 """Native C++ KV store: interop with the Python FileKV twin (same
-on-disk format), tombstones, torn-tail recovery, compaction."""
+on-disk format), tombstones, torn-tail recovery, compaction, and the
+SHARED torn-batch/corruption replay suite (tests/kv_corruption.py —
+the full parametrized matrix runs in tests/test_kv_corruption.py)."""
 
 import os
 
 import pytest
 
-from harmony_tpu.core.kv import FileKV
+import kv_corruption as KC
+from harmony_tpu.core.kv import FileKV, WriteBatch
 from harmony_tpu.core.kv_native import NativeKV, available
 
 pytestmark = pytest.mark.skipif(
@@ -103,10 +106,50 @@ def test_native_torn_value_recovery(tmp_path):
     py.close()
 
     # corrupt klen = 0xFFFFFFFE: open must succeed (truncating) or at
-    # worst return a handle error — never abort the process
+    # worst return a handle error — never abort the process.  (That
+    # klen is now the batch BEGIN sentinel: an orphaned marker with no
+    # COMMIT is exactly a torn batch and must be discarded.)
     path2 = str(tmp_path / "badklen.db")
     with open(path2, "wb") as f:
         f.write(b"\xfe\xff\xff\xff" + b"\x01\x00\x00\x00" + b"xx")
     db = NativeKV(path2)
     assert db.get(b"xx") is None
     db.close()
+
+
+def test_native_batch_parity_with_filekv(tmp_path):
+    """kv_write_batch: all-or-nothing on disk, marker grammar readable
+    by the Python twin, torn native batches discarded by BOTH."""
+    path = str(tmp_path / "batch.db")
+    db = NativeKV(path)
+    db.put(b"pre", b"existing")
+    batch = WriteBatch()
+    batch.put(b"b1", b"v1")
+    batch.put(b"pre", b"overwritten")
+    batch.delete(b"b1")
+    db.write_batch(batch)
+    assert db.get(b"b1") is None
+    assert db.get(b"pre") == b"overwritten"
+    db.flush()
+    db.close()
+    py = FileKV(path)
+    assert py.get(b"b1") is None and py.get(b"pre") == b"overwritten"
+    py.close()
+
+    # a torn batch appended behind the native store's back: both
+    # stores must discard it and keep the committed prefix
+    with open(path, "ab") as f:
+        f.write(KC.marker(0xFFFFFFFE, 2) + KC.rec(b"lost", b"L"))
+    for factory in (NativeKV, FileKV):
+        db = factory(path)
+        assert db.get(b"lost") is None
+        assert db.get(b"pre") == b"overwritten"
+        db.close()
+
+
+def test_native_runs_shared_corruption_cases(tmp_path):
+    """The native store must reach the same verdict as FileKV on every
+    shared corruption fixture (the parametrized matrix also runs in
+    test_kv_corruption.py; this pins the suite to the native tier)."""
+    for name, tail, expect in KC.CASES:
+        KC.run_case(NativeKV, str(tmp_path / f"{name}.db"), tail, expect)

@@ -40,7 +40,7 @@
 #      flags; measurement redefinitions are exempt).
 #   7. chaos sweep — the composed adversarial tier: the chaostest
 #      framework unit tests, then tools/chaos_sweep.py --quick
-#      --check runs all five named scenarios (leader black-holed
+#      --check runs the five composed scenarios (leader black-holed
 #      under flood, epoch-boundary election under saturated lanes,
 #      cross-shard traffic under partition, validator churn at the
 #      quorum edge, sidecar flapping during quorum assembly) and
@@ -50,6 +50,16 @@
 #      gates them against the committed history (wide 80% threshold:
 #      composed-scenario latencies jitter more than kernel benches
 #      on this box).
+#   8. crash consistency — the durability tier (ISSUE 12): the KV
+#      corruption/batch-replay suite (FileKV × NativeKV parity) and
+#      the chain-level recovery tests, then tools/crash_sweep.py
+#      --check (kill a block commit at EVERY enumerated kv.commit
+#      crash point + byte-truncation offset; reopen must recover a
+#      consistent head with zero manual repair), then the two
+#      restart scenarios (leader hard-killed mid-commit + rolling
+#      restarts of all validators) via chaos_sweep on durable
+#      topologies; crash_* and restart_recovery_seconds_p99 land as
+#      an ephemeral BENCH round gated by bench_ledger --check.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -98,10 +108,28 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_chaostest.py
 CHAOS_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND"' EXIT
+CRASH_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario view_change_storm --scenario epoch_election_rotation \
+  --scenario cross_shard_partition --scenario validator_churn \
+  --scenario sidecar_flap \
   --bench-out "$CHAOS_ROUND" --bench-round 999 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$CHAOS_ROUND" > /dev/null
+
+echo "== crash consistency: kv replay parity + crash-point sweep + restart scenarios =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_kv_corruption.py \
+  tests/test_crash_recovery.py
+JAX_PLATFORMS=cpu python tools/crash_sweep.py --check \
+  --bench-out "$CRASH_ROUND" --bench-round 998 > /dev/null
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario leader_kill_restart --scenario rolling_restart \
+  --bench-base "$CRASH_ROUND" --bench-out "$CRASH_ROUND" \
+  --bench-round 998 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$CRASH_ROUND" > /dev/null
 
 echo "check.sh: OK"

@@ -18,10 +18,18 @@ Round-4 scenarios (VERDICT r3 #4):
                       submitted over RPC and must land as balance on
                       shard 1 (live CXReceiptsProof routing over TCP)
 
+Durable operator runs (ISSUE 12): ``--data-dir PATH`` pins every
+node's shard DB (NativeKV/FileKV) + tx journal + logs to a persistent
+directory — Ctrl-C the net, relaunch with the same flag, and every
+node reopens its chain from disk through crash recovery (torn batches
+discarded, head verified, last-signed views reloaded) and resumes
+committing where it stopped.
+
 Usage:
     python tools/localnet.py --nodes 8 --blocks 6 --multikey 2
     python tools/localnet.py --nodes 8 --blocks 5 --kill-leader-at 2
     python tools/localnet.py --nodes 3 --shards 2 --cross-shard --blocks 8
+    python tools/localnet.py --nodes 4 --data-dir /tmp/my-localnet
 """
 
 from __future__ import annotations
@@ -238,6 +246,13 @@ def main(argv=None):
                         "oversubscribed boxes (N nodes share the core)")
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--keep-data", action="store_true")
+    p.add_argument("--data-dir", default=None,
+                   help="persistent data directory: nodes open their "
+                        "shard DBs (NativeKV/FileKV) here and a "
+                        "relaunch with the same dir RESUMES the chain "
+                        "from disk (crash recovery + tx journals); "
+                        "implies --keep-data.  Default: a throwaway "
+                        "tempdir")
     p.add_argument("--device-path", action="store_true",
                    help="force the DEVICE verification path on every "
                         "node and assert (via metrics) that quorum "
@@ -253,7 +268,16 @@ def main(argv=None):
     if args.cross_shard and args.shards < 2:
         args.shards = 2
 
-    workdir = pathlib.Path(tempfile.mkdtemp(prefix="harmony-tpu-localnet-"))
+    if args.data_dir:
+        # durable operator localnet: survives Ctrl-C + relaunch (each
+        # node reopens its shard DB through crash recovery)
+        workdir = pathlib.Path(args.data_dir).absolute()
+        workdir.mkdir(parents=True, exist_ok=True)
+        args.keep_data = True
+    else:
+        workdir = pathlib.Path(
+            tempfile.mkdtemp(prefix="harmony-tpu-localnet-")
+        )
     net = Net(args, workdir)
     t_first_block = None
     killed_at = None
