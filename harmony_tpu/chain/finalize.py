@@ -163,8 +163,17 @@ class Finalizer:
 
     def _elect(self, state, epoch: int) -> ShardState:
         orders = {}
+        banned_keys: set = set()
         for addr in state.validator_addresses():
             w = state.validator(addr)
+            if w.status == 2:
+                # a slashed (banned) validator's KEYS are barred from
+                # the auction outright — not just its order: a
+                # double-sign key must not re-enter the committee under
+                # any order (reference: banned validators never
+                # re-elect; status is permanent)
+                banned_keys.update(w.bls_keys)
+                continue
             if w.status != 0 or not w.bls_keys:
                 continue
             if w.self_delegation() < w.min_self_delegation:
@@ -186,6 +195,7 @@ class Finalizer:
                 self.cfg.external_slots_per_shard * self.cfg.shard_count
             ),
             extended_bound=self.cfg.extended_bound,
+            exclude_keys=frozenset(banned_keys),
         )
         # membership bookkeeping only for validators actually elected
         # (the reference stamps LastEpochInCommittee from the NEW shard

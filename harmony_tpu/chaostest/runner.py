@@ -78,6 +78,8 @@ class NodeHandle:
     keys: list = None          # BLS keys, kept for restart wiring
     killed_at: float = None    # monotonic time of the last hard kill
     restarts: int = 0
+    byz: bool = False          # an ACTIVE adversary (ByzantineNode):
+    #                            excluded from liveness/fork invariants
 
 
 @dataclass
@@ -98,11 +100,17 @@ class RunEnv:
     def by_shard(self, shard: int) -> list:
         return [h for h in self.handles if h.shard == shard]
 
+    def honest(self, shard: int) -> list:
+        """The shard's honest nodes — what the liveness / fork
+        invariants judge.  An adversary's own chain is its problem."""
+        return [h for h in self.by_shard(shard) if not h.byz]
+
     def shard_head(self, shard: int) -> int:
-        """Network head: max over the shard (a partitioned or lagging
-        node must not mask the committee's progress)."""
+        """Network head: max over the shard's HONEST nodes (a
+        partitioned, lagging or lying node must not mask — or fake —
+        the committee's progress)."""
         return max(
-            (h.node.chain.head_number for h in self.by_shard(shard)),
+            (h.node.chain.head_number for h in self.honest(shard)),
             default=0,
         )
 
@@ -242,7 +250,25 @@ def _build(scenario: Scenario, registry, built: list | None = None
             handle.chain, listen_port=handle.sync_port
         )
         handle.sync_port = handle.sync_server.port
-        handle.node = Node(reg, PrivateKeys.from_keys(handle.keys))
+        byz_map = dict(top.byzantine)
+        if handle.name in byz_map:
+            from .byzantine import ByzantineNode
+
+            behaviors = byz_map[handle.name].split("+")
+            # double-voters sign their conflicting ballots with the
+            # staked external key (when the topology seats one): the
+            # offense must be attributable to a slashable validator
+            adversary = None
+            if "double_vote" in behaviors and env.ext_keys:
+                adversary = {env.ext_keys[0].pub.bytes}
+            handle.byz = True
+            handle.node = ByzantineNode(
+                reg, PrivateKeys.from_keys(handle.keys),
+                behaviors=behaviors, adversary_keys=adversary,
+                seed=scenario.seed,
+            )
+        else:
+            handle.node = Node(reg, PrivateKeys.from_keys(handle.keys))
         handle._registry = reg
 
     def wire_sync(handle: NodeHandle):
@@ -732,13 +758,13 @@ def _check_invariants(env: RunEnv, sheds: float) -> list:
         violations.append({"invariant": name, "detail": detail})
 
     heads = {
-        s: [h.node.chain.head_number for h in env.by_shard(s)]
+        s: [h.node.chain.head_number for h in env.honest(s)]
         for s in range(top.shards)
     }
     if any(min(hs) < inv.min_blocks for hs in heads.values()):
         violated(
             "liveness",
-            f"heads {heads} below min_blocks={inv.min_blocks}",
+            f"honest heads {heads} below min_blocks={inv.min_blocks}",
         )
     if inv.zero_consensus_sheds and sheds > 0:
         violated("zero_consensus_sheds",
@@ -754,7 +780,7 @@ def _check_invariants(env: RunEnv, sheds: float) -> list:
         )
     if inv.no_divergent_heads:
         for s in range(top.shards):
-            hs = env.by_shard(s)
+            hs = env.honest(s)
             common = min(h.node.chain.head_number for h in hs)
             if common < 1:
                 continue
@@ -766,7 +792,7 @@ def _check_invariants(env: RunEnv, sheds: float) -> list:
                 violated(
                     "no_divergent_heads",
                     f"shard {s} forked at height {common}: "
-                    f"{len(hashes)} distinct blocks",
+                    f"{len(hashes)} distinct blocks among honest nodes",
                 )
     if inv.min_view_changes:
         vcs = sum(h.node.new_views_adopted for h in env.handles)
@@ -779,7 +805,7 @@ def _check_invariants(env: RunEnv, sheds: float) -> list:
     if inv.min_epochs:
         epochs = min(
             h.node.chain.epoch_of(h.node.chain.head_number)
-            for h in env.by_shard(0)
+            for h in env.honest(0)
         )
         if epochs < inv.min_epochs:
             violated(
@@ -925,7 +951,7 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
             heads_ok = all(
                 h.node.chain.head_number
                 >= scenario.invariants.min_blocks
-                for h in env.handles
+                for h in env.handles if not h.byz
             )
             tick += 1
             if (heads_ok and phases_done.is_set()
@@ -1028,7 +1054,10 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
     )
     metrics = {
         "blocks_min": _m(
-            min(min(hs) for hs in heads.values()), "blocks",
+            min(
+                min(h.node.chain.head_number for h in env.honest(s))
+                for s in range(scenario.topology.shards)
+            ), "blocks",
             floor=scenario.invariants.min_blocks,
         ),
         "round_p99_s": _m(
@@ -1052,6 +1081,10 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         "run_s": _m(round(run_s, 2), "s",
                     window_s=scenario.window_s),
     }
+    # scenario-specific measured extras (the byzantine scenarios stash
+    # their evidence-pipeline numbers here from custom invariants)
+    for name, entry in (env.data.get("extra_metrics") or {}).items():
+        metrics[name] = entry
     restarts = sum(h.restarts for h in env.handles)
     if restarts:
         recov = env.data.get("recovery_s", [])

@@ -51,6 +51,12 @@ class Topology:
     #                            hard-killed and reopened from disk
     block_time_s: float = 0.25
     phase_timeout_s: float = 8.0  # consensus timeout -> view change
+    # ACTIVE adversaries: (node_name, "behavior[+behavior...]") pairs —
+    # those nodes are built as chaostest.byzantine.ByzantineNode with
+    # the named behaviors (equivocate / double_vote / invalid_proposal
+    # / withhold / wire_spray).  Liveness/divergence invariants then
+    # judge the HONEST nodes only; the adversary is the fault.
+    byzantine: tuple = ()
 
 
 @dataclass(frozen=True)

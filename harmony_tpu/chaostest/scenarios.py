@@ -386,6 +386,251 @@ def rolling_restart(quick: bool = False) -> Scenario:
     )
 
 
+# -- byzantine scenarios (ISSUE 13): ACTIVE adversaries ----------------------
+
+
+def _byz_handles(env):
+    return [h for h in env.handles if h.byz]
+
+
+def _m(value, unit, **fields):
+    from .runner import _m as m
+
+    return m(value, unit, **fields)
+
+
+def _byz_equivocation_survived(env):
+    """The adversary must have actually equivocated (and withheld), and
+    the honest committee must have stormed past its wedged rounds."""
+    byz = _byz_handles(env)
+    if not byz:
+        return False, "no byzantine node was built"
+    acts = byz[0].node.byz_actions
+    if acts["equivocate"] < 2:
+        # the orders alternate: the FIRST equivocation (twin second)
+        # is absorbed by first-announce-wins, the SECOND (twin first)
+        # splits the committee from the leader and wedges the round —
+        # the run must stay open until both postures happened
+        return False, (
+            f"only {acts['equivocate']} equivocations (need both "
+            "delivery postures)"
+        )
+    if acts["withhold"] < 1:
+        return False, "the adversary never withheld a vote"
+    if sum(h.node.new_views_adopted for h in env.honest(0)) < 1:
+        return False, "the wedged round never view-changed"
+    env.data["extra_metrics"] = {
+        "byz_equivocations": _m(acts["equivocate"], "announces"),
+        "byz_votes_withheld": _m(acts["withhold"], "votes"),
+    }
+    return True, ""
+
+
+def _byz_evidence_applied(env):
+    """The whole slashing pipeline, end to end: the double vote was
+    cast, DETECTED by an honest leader, block-INCLUDED (some honest
+    header carries slash records), re-verified and APPLIED — offender's
+    stake measurably reduced, reporter's balance measurably credited,
+    offender banned and excluded from the next election."""
+    from ..staking import slash as SL
+
+    byz = _byz_handles(env)
+    if not byz:
+        return False, "no byzantine node was built"
+    if byz[0].node.byz_actions["double_vote"] < 1:
+        return False, "the adversary never double-voted"
+    honest = env.honest(0)
+    detected = sum(h.node.double_sign_events for h in honest)
+    if detected < 1:
+        return False, "no honest leader detected the double vote"
+    offender = env.ecdsa_keys[0].address()  # the ext validator's staker
+    chain = honest[0].node.chain
+    w = chain.state().validator(offender)
+    if w is None:
+        return False, "external validator never registered"
+    if w.status != 2:
+        return False, "offender not banned (evidence never applied)"
+    stake0 = 10**20  # fixtures.external_validator_stake amount
+    slashed = stake0 - w.total_delegation()
+    if slashed <= 0:
+        return False, "offender stake not reduced"
+    included_at = None
+    reporter = None
+    for n in range(1, chain.head_number + 1):
+        hdr = chain.header_by_number(n)
+        if hdr is not None and hdr.slashes:
+            included_at = n
+            reporter = SL.decode_records(hdr.slashes)[0].reporter
+            break
+    if included_at is None:
+        return False, "no committed block carried a slash record"
+    # the reporter is a dev-genesis account (alloc 10**24); gas spend
+    # is ~1e5 atto while the reward is 1e18 — a credited reporter sits
+    # measurably ABOVE its allocation
+    reward_floor = 10**24 + 10**17
+    if chain.state().balance(reporter) < reward_floor:
+        return False, "reporter balance shows no slash reward"
+    # the election AFTER the ban must drop the offender's key
+    ext = env.ext_keys[0].pub.bytes
+    top_epoch = chain.epoch_of(chain.head_number)
+    if ext in chain.committee_for_epoch(top_epoch):
+        return False, (
+            f"slashed key still elected at epoch {top_epoch}"
+        )
+    env.data["extra_metrics"] = {
+        "byz_double_votes": _m(
+            byz[0].node.byz_actions["double_vote"], "votes"
+        ),
+        "byz_evidence_detected": _m(detected, "records"),
+        "byz_evidence_included_block": _m(included_at, "block"),
+        "byz_offender_stake_slashed_atto": _m(slashed, "atto"),
+        "byz_evidence_applied": _m(1, "records"),
+    }
+    return True, ""
+
+
+def _byz_spray_defended(env):
+    """The hostile-wire defense must have engaged: honest validators
+    REJECTed the sprayed garbage (scored, throttled) and the hub
+    ultimately muted the adversary — while every honest node kept
+    committing (the liveness floor checks that part)."""
+    byz = _byz_handles(env)
+    if not byz:
+        return False, "no byzantine node was built"
+    acts = byz[0].node.byz_actions
+    if acts["invalid_proposal"] < 1:
+        return False, "the adversary never proposed an invalid block"
+    if acts["wire_spray"] < 10:
+        return False, f"only {acts['wire_spray']} wires sprayed"
+    if env.net.invalid_total < 10:
+        return False, (
+            f"only {env.net.invalid_total} invalid-message verdicts "
+            "observed (the spray was not rejected)"
+        )
+    if byz[0].name not in env.net.muted:
+        return False, "the spraying peer was never muted"
+    if sum(h.node.new_views_adopted for h in env.honest(0)) < 1:
+        # the muted adversary's garbage (or silent) round must have
+        # been routed around by a completed view change at least once
+        return False, "no honest view change routed around the sprayer"
+    env.data["extra_metrics"] = {
+        "byz_invalid_proposals": _m(acts["invalid_proposal"],
+                                    "announces"),
+        "byz_wires_sprayed": _m(acts["wire_spray"], "frames"),
+        "byz_invalid_verdicts": _m(env.net.invalid_total, "rejects"),
+        "byz_peers_muted": _m(len(env.net.muted), "peers"),
+    }
+    return True, ""
+
+
+def byz_equivocating_leader(quick: bool = False) -> Scenario:
+    """An ACTIVE adversary holding one of six committee keys
+    equivocates whenever it leads (conflicting ANNOUNCEs for the same
+    height/view — alternating delivery order, so half its rounds wedge
+    into real view changes) and withholds its votes otherwise (the
+    quorum-edge coalition: 5-of-6 keys must still commit).  Honest
+    nodes must keep committing on ONE history."""
+    return Scenario(
+        name="byz_equivocating_leader",
+        seed=37,
+        topology=Topology(
+            nodes=4, multikey=2, block_time_s=0.2,
+            phase_timeout_s=2.0 if quick else 4.0,
+            byzantine=(("s0n3", "equivocate+withhold"),),
+        ),
+        traffic=Traffic(
+            plain_rate=100.0 if quick else 300.0,
+            replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        invariants=Invariants(
+            min_blocks=5 if quick else 9,
+            round_p99_s=30.0,
+            min_view_changes=1,
+            custom=(
+                ("byz_equivocation_survived",
+                 _byz_equivocation_survived),
+            ),
+        ),
+        window_s=110.0 if quick else 220.0,
+    )
+
+
+def byz_double_voter_slashed(quick: bool = False) -> Scenario:
+    """The end-to-end slashing acceptance: a staked external validator
+    (riding the byzantine node as a multi-key slot) double-votes in the
+    commit phase every round once elected.  An honest leader must
+    detect it, gossip + include the evidence in a proposal, every
+    validator must re-verify it before voting, and finalization must
+    apply it — offender slashed and banned, reporter rewarded, the
+    slashed key excluded from the next election — while the committee
+    (f=1 of 7 keys) keeps committing."""
+    return Scenario(
+        name="byz_double_voter_slashed",
+        seed=41,
+        topology=Topology(
+            nodes=4, multikey=2, staking=True, external_validators=1,
+            blocks_per_epoch=4, block_time_s=0.25,
+            phase_timeout_s=6.0 if quick else 9.0,
+            byzantine=(("s0n0", "double_vote"),),
+        ),
+        traffic=Traffic(
+            plain_rate=80.0 if quick else 250.0,
+            pop_rate=6.0, replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        invariants=Invariants(
+            min_blocks=10 if quick else 14,
+            round_p99_s=30.0,
+            min_epochs=2 if quick else 3,
+            custom=(
+                ("byz_evidence_applied", _byz_evidence_applied),
+            ),
+        ),
+        window_s=130.0 if quick else 260.0,
+    )
+
+
+def byz_invalid_proposal_flood(quick: bool = False) -> Scenario:
+    """An adversary that proposes only invalid blocks (rotating bad
+    state root / forged parent seal / wrong view / garbage slash
+    payload) AND sprays malformed wires at the consensus + slash
+    topics.  Honest validators must reject every proposal (losing only
+    the adversary's own rounds to view changes), survive every
+    malformed frame, and score-throttle-mute the spraying peer."""
+    return Scenario(
+        name="byz_invalid_proposal_flood",
+        seed=43,
+        topology=Topology(
+            # f=1 key of 6 (ISSUE 13's committee shape): once the hub
+            # mutes the sprayer, its leader slot is a PERMANENT dead
+            # view — 1-in-6 rounds must view-change past it forever,
+            # so the committee tolerates the hole, not a window
+            nodes=4, multikey=2, block_time_s=0.2,
+            phase_timeout_s=3.0 if quick else 5.0,
+            byzantine=(("s0n3", "invalid_proposal+wire_spray"),),
+        ),
+        traffic=Traffic(
+            plain_rate=100.0 if quick else 300.0,
+            replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        # the p99 bound is storm-shaped, not commit-shaped: a round
+        # whose initial views land on the muted adversary's slot SPANS
+        # the escalating view-change ladder by design (same rationale
+        # as leader_kill_restart) — the bound guards against a wedge
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=90.0,
+            min_view_changes=1,
+            custom=(
+                ("byz_spray_defended", _byz_spray_defended),
+            ),
+        ),
+        window_s=130.0 if quick else 260.0,
+    )
+
+
 SCENARIOS = {
     "view_change_storm": view_change_storm,
     "epoch_election_rotation": epoch_election_rotation,
@@ -394,4 +639,7 @@ SCENARIOS = {
     "sidecar_flap": sidecar_flap,
     "leader_kill_restart": leader_kill_restart,
     "rolling_restart": rolling_restart,
+    "byz_equivocating_leader": byz_equivocating_leader,
+    "byz_double_voter_slashed": byz_double_voter_slashed,
+    "byz_invalid_proposal_flood": byz_invalid_proposal_flood,
 }

@@ -209,6 +209,10 @@ def encode_message(msg: FBFTMessage) -> bytes:
 
 
 def decode_message(data: bytes) -> FBFTMessage:
+    """Bounded decode: every length prefix is checked against the
+    remaining bytes BEFORE its slice, so a length-inflated wire raises
+    (typed) instead of silently truncating into garbage fields — a
+    forged frame costs its own size, never more."""
     view = memoryview(data)
     if len(view) < 1 + 8 + 8 + 32 + 4:
         raise ValueError("message too short")
@@ -218,25 +222,35 @@ def decode_message(data: bytes) -> FBFTMessage:
     block_num = int.from_bytes(view[off:off + 8], "little"); off += 8
     block_hash = bytes(view[off:off + 32]); off += 32
     n_keys = int.from_bytes(view[off:off + 4], "little"); off += 4
-    if n_keys > 4096:
+    if n_keys > 4096 or n_keys * PUBKEY_BYTES > len(view) - off:
         raise ValueError("absurd key count")
     keys = []
     for _ in range(n_keys):
         keys.append(bytes(view[off:off + PUBKEY_BYTES]))
         off += PUBKEY_BYTES
-    plen = int.from_bytes(view[off:off + 4], "little"); off += 4
-    payload = bytes(view[off:off + plen]); off += plen
-    blen = int.from_bytes(view[off:off + 4], "little"); off += 4
-    block = bytes(view[off:off + blen]); off += blen
-    slen = int.from_bytes(view[off:off + 4], "little"); off += 4
-    sender_sig = bytes(view[off:off + slen]); off += slen
+
+    def _field(width: int) -> bytes:
+        nonlocal off
+        if len(view) - off < width:
+            raise ValueError("truncated length prefix")
+        ln = int.from_bytes(view[off:off + width], "little")
+        off += width
+        if ln > len(view) - off:
+            raise ValueError(
+                f"field length {ln} overruns message "
+                f"({len(view) - off} bytes left)"
+            )
+        out = bytes(view[off:off + ln])
+        off += ln
+        return out
+
+    payload = _field(4)
+    block = _field(4)
+    sender_sig = _field(4)
     trace_ctx = b""
     if off != len(view):
         # optional trace-context trailer (u16 len + bytes)
-        if len(view) - off < 2:
-            raise ValueError("trailing bytes in message")
-        tlen = int.from_bytes(view[off:off + 2], "little"); off += 2
-        trace_ctx = bytes(view[off:off + tlen]); off += tlen
+        trace_ctx = _field(2)
         if off != len(view):
             raise ValueError("trailing bytes in message")
     return FBFTMessage(

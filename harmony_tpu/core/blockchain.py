@@ -320,6 +320,130 @@ class Blockchain:
             return self.finalizer.elect(state, epoch + 1)
         return None
 
+    # -- slashing (reference: staking/slash/double-sign.go Verify+Apply) ----
+
+    def verify_slash_record(self, record, block_num: int) -> None:
+        """Chain-side checks layered over the pure evidence
+        verification (the reference's Verify does both: the ballot
+        crypto AND the chain-state lookups): the moment must be in this
+        chain's past, its committee must be resolvable locally, and the
+        double-sign keys must have held slots in THAT epoch.  Raises
+        ChainError."""
+        from ..staking.slash import SlashVerifyError, verify_record
+
+        ev = record.evidence
+        m = ev.moment
+        if m.shard_id != self.shard_id:
+            raise ChainError("slash record from another shard")
+        if m.height >= block_num:
+            raise ChainError("slash evidence from the future")
+        if m.epoch > self.epoch_of(block_num):
+            raise ChainError("slash evidence epoch ahead of the chain")
+        if m.epoch != self.epoch_of(m.height):
+            raise ChainError("slash moment epoch/height disagree")
+        committee = self.committee_for_epoch(m.epoch)
+        try:
+            verify_record(
+                record, committee,
+                is_staking=self.config.is_staking(m.epoch),
+            )
+        except SlashVerifyError as e:
+            raise ChainError(f"invalid slash record: {e}") from e
+
+    def apply_slash_records(self, state, records: list,
+                            block_num: int, observe: bool = True) -> int:
+        """Verify + apply ``records`` to ``state`` — the economics the
+        reference runs in Finalize (double-sign.go Apply): slash the
+        offender's delegations at the double-sign rate, reward the
+        reporter half the slashed amount, BAN the offender (status 2 —
+        which also bars its keys from every later election and, because
+        a banned offender can never be slashed again, dedups the same
+        evidence across blocks).  Deterministic: runs identically on
+        the proposer, the pre-vote dry run, and replay, BEFORE the
+        state root is sealed/checked.  Returns total atto slashed.
+        ``observe=False`` suppresses the harmony_slash_* counters and
+        the log line — dry runs (proposer candidate filtering, the
+        validator's pre-vote speculation) must not inflate the
+        'applied' stage or the atto amounts actually moved."""
+        from ..staking import slash as SL
+
+        if not records:
+            return 0
+        if len(records) > SL.MAX_SLASHES_PER_BLOCK:
+            raise ChainError("too many slash records in one block")
+        total = 0
+        seen: set = set()
+        for record in records:
+            fp = SL.record_fingerprint(record)
+            if fp in seen:
+                raise ChainError("duplicate slash record in block")
+            seen.add(fp)
+            self.verify_slash_record(record, block_num)
+            if observe:
+                SL.COUNTERS.inc("verified")
+            offender = record.evidence.offender
+            w = state.validator(offender)
+            if w is None:
+                raise ChainError("slash offender is not a validator")
+            if w.status == 2:
+                raise ChainError("slash offender already banned")
+            app = SL.apply_slash(w.total_delegation())
+            # burn from delegations in order (deterministic; the
+            # reference burns self-delegation first — delegations[0]
+            # is the self-delegation by construction)
+            left = app.total_slashed
+            for d in w.delegations:
+                take = min(d.amount, left)
+                d.amount -= take
+                left -= take
+                if left == 0:
+                    break
+            w.status = 2  # double-sign ban (permanent)
+            if record.reporter and record.reporter != offender:
+                state.add_balance(
+                    record.reporter, app.total_beneficiary_reward
+                )
+                if observe:
+                    SL.AMOUNTS.inc(
+                        "reward_atto", app.total_beneficiary_reward
+                    )
+            total += app.total_slashed
+            if observe:
+                SL.COUNTERS.inc("applied")
+                SL.AMOUNTS.inc("slashed_atto", app.total_slashed)
+                _log.warn(
+                    "slash applied", offender=offender.hex()[:12],
+                    slashed=app.total_slashed, block=block_num,
+                    shard=self.shard_id,
+                )
+        return total
+
+    def apply_slashes(self, state, slashes_bytes: bytes,
+                      block_num: int, observe: bool = True,
+                      version: str = "v3") -> int:
+        """Header-bytes entry point (replay + the validator's pre-vote
+        dry run): bounded decode, then verify + apply.  ``version`` is
+        the carrying header's version: only v3 headers HASH the
+        slashes field, so slashes riding any other version are
+        unsigned malleable bytes — a relay could splice a valid record
+        into an honest proposal without changing its hash and split
+        the committee on the derived root.  Reject them outright."""
+        from ..staking.slash import decode_records
+
+        if not slashes_bytes:
+            return 0
+        if version != "v3":
+            raise ChainError(
+                f"header version {version!r} does not hash its "
+                "slashes field; carried slash bytes are unsigned"
+            )
+        try:
+            records = decode_records(slashes_bytes)
+        except (ValueError, IndexError) as e:
+            raise ChainError(f"bad slash payload: {e}") from e
+        return self.apply_slash_records(state, records, block_num,
+                                        observe=observe)
+
     def _execute(self, block: Block):
         state = self._state.copy()
         epoch = block.header.epoch
@@ -331,6 +455,13 @@ class Blockchain:
             result.receipts + result.staking_receipts
         ) != block.header.receipt_root:
             raise ChainError("receipt root mismatch after execution")
+        # included slash records re-verify against the moment's epoch
+        # committee and apply BEFORE finalization — the state the
+        # header seals includes their effect, so a fabricated record
+        # can never survive the root check, and an invalid one rejects
+        # the whole block (exactly the reference's Verify-on-inclusion)
+        self.apply_slashes(state, block.header.slashes, block.block_num,
+                           version=block.header.version)
         elected = self.post_process(
             state, block.block_num, epoch,
             block.header.last_commit_bitmap or None,

@@ -39,6 +39,11 @@ _VC_WATERMARK = b"W"    # W || bls_pubkey -> highest view-change signed
 
 # -- codecs -----------------------------------------------------------------
 
+def _checked_count(r: _Reader, width: int = 4) -> int:
+    """Bounded count for the gossip-fed blobs (ANNOUNCE block bytes,
+    CX proofs, sync pages, epoch states) — Reader.checked_count."""
+    return r.checked_count(width)
+
 _HEADER_FIELDS = (
     # (name, kind) in storage order — every dataclass field, version
     # included, so the store round-trips any header version losslessly
@@ -155,12 +160,12 @@ def decode_cx_proof(blob: bytes):
     from .types import CXReceiptsProof
 
     r = _Reader(blob)
-    receipts = [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    receipts = [decode_cx(r.bytes_()) for _ in range(_checked_count(r))]
     header_bytes = r.bytes_()
     commit_sig = r.bytes_()
     commit_bitmap = r.bytes_()
     shard_ids, shard_hashes = [], []
-    for _ in range(r.int_(4)):
+    for _ in range(_checked_count(r)):
         shard_ids.append(r.int_(4))
         shard_hashes.append(r.bytes_())
     return CXReceiptsProof(
@@ -197,10 +202,11 @@ def encode_body(block: Block, chain_id: int) -> bytes:
 
 def decode_body(blob: bytes):
     r = _Reader(blob)
-    txs = [decode_tx(r.bytes_()) for _ in range(r.int_(4))]
-    stxs = [decode_staking_tx(r.bytes_()) for _ in range(r.int_(4))]
-    cxps = [decode_cx_proof(r.bytes_()) for _ in range(r.int_(4))]
-    order = list(r.raw(r.int_(4)))
+    txs = [decode_tx(r.bytes_()) for _ in range(_checked_count(r))]
+    stxs = [decode_staking_tx(r.bytes_())
+            for _ in range(_checked_count(r))]
+    cxps = [decode_cx_proof(r.bytes_()) for _ in range(_checked_count(r))]
+    order = list(r.raw(_checked_count(r)))
     return txs, stxs, cxps, order
 
 
@@ -422,9 +428,9 @@ def decode_shard_state(blob: bytes):
 
     r = _Reader(blob)
     state = State(epoch=r.int_())
-    for _ in range(r.int_(4)):
+    for _ in range(_checked_count(r)):
         com = Committee(shard_id=r.int_(4))
-        for _ in range(r.int_(4)):
+        for _ in range(_checked_count(r)):
             addr = r.bytes_()
             key = r.bytes_()
             has_stake = r.int_(1)

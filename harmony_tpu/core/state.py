@@ -363,19 +363,10 @@ class StateDB:
 
 
 def _checked_count(r: Reader, width: int) -> int:
-    """A length-prefixed element count, REJECTED when it cannot fit in
-    the remaining bytes (each element consumes >= 1 byte).  The Reader
-    reads silently past EOF (empty slices), so a corrupt blob's bogus
-    count would otherwise spin a billion no-op iterations — recovery-
-    on-open feeds crash-damaged blobs straight into this decoder and
-    must get a ValueError, never a wedge."""
-    n = r.int_(width)
-    if n > len(r.view) - r.off:
-        raise ValueError(
-            f"implausible element count {n} with "
-            f"{len(r.view) - r.off} bytes left"
-        )
-    return n
+    """Bounded count for crash-damaged blobs (recovery-on-open feeds
+    them straight into this decoder and must get a ValueError, never a
+    billion-iteration wedge) — Reader.checked_count."""
+    return r.checked_count(width)
 
 
 def _decode_account(blob: bytes) -> Account:

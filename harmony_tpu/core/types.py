@@ -63,6 +63,21 @@ class Reader:
         self.off += n
         return out
 
+    def checked_count(self, width: int = 4) -> int:
+        """A length-prefixed element count, REJECTED when it cannot
+        fit in the remaining bytes (each element consumes >= 1 byte).
+        The Reader slices silently past EOF, so a forged count in a
+        wire/crash-fed blob would otherwise spin a garbage-object loop
+        bounded only by the prefix width — hostile inputs must cost
+        their own size, never 4 G iterations."""
+        n = self.int_(width)
+        if n > len(self.view) - self.off:
+            raise ValueError(
+                f"implausible element count {n} with "
+                f"{len(self.view) - self.off} bytes left"
+            )
+        return n
+
     def eof(self) -> bool:
         return self.off >= len(self.view)
 

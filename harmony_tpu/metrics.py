@@ -221,10 +221,67 @@ class Registry:
         lines.append(self._device_counters())
         lines.append(self._resilience_counters())
         lines.append(self._sched_counters())
+        lines.append(self._p2p_counters())
+        lines.append(self._slash_counters())
         prof = self._prof_counters()
         if prof:
             lines.append(prof)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _p2p_counters() -> str:
+        """Hostile-wire defense surface (p2p.host module singletons):
+        invalid-message verdicts per transport, the throttle/drop/ban
+        ladder, and the worst live per-peer score per host."""
+        from .p2p import host as PH
+
+        out = [
+            "# HELP harmony_p2p_invalid_messages_total invalid-message "
+            "events by kind (REJECT verdicts, throttles, drops, bans)",
+            "# TYPE harmony_p2p_invalid_messages_total counter",
+        ]
+        for kind, v in PH.P2P_COUNTERS.items():
+            out.append(
+                f'harmony_p2p_invalid_messages_total{{kind="{kind}"}} {v}'
+            )
+        out.append(
+            "# HELP harmony_p2p_peer_score worst per-peer gossip "
+            "score observed at each host since process start "
+            "(a low-water mark, not a live reading)\n"
+            "# TYPE harmony_p2p_peer_score gauge"
+        )
+        for host_name, score in sorted(PH.worst_peer_scores().items()):
+            out.append(
+                f'harmony_p2p_peer_score{{host="{host_name}"}} {score:g}'
+            )
+        return "\n".join(out)
+
+    @staticmethod
+    def _slash_counters() -> str:
+        """Double-sign slashing pipeline (staking.slash module
+        singletons): detected -> gossiped -> queued -> included ->
+        verified -> applied event counts plus the atto amounts moved."""
+        from .staking import slash as SL
+
+        out = [
+            "# HELP harmony_slash_events_total slashing pipeline "
+            "events by stage",
+            "# TYPE harmony_slash_events_total counter",
+        ]
+        for kind, v in SL.COUNTERS.items():
+            out.append(
+                f'harmony_slash_events_total{{stage="{kind}"}} {v}'
+            )
+        out.append(
+            "# HELP harmony_slash_amount_atto_total atto slashed from "
+            "offenders / rewarded to reporters\n"
+            "# TYPE harmony_slash_amount_atto_total counter"
+        )
+        for kind, v in SL.AMOUNTS.items():
+            out.append(
+                f'harmony_slash_amount_atto_total{{kind="{kind}"}} {v}'
+            )
+        return "\n".join(out)
 
     @staticmethod
     def _device_counters() -> str:

@@ -34,6 +34,11 @@ class MessageCategory(IntEnum):
     NODE = 0x01
 
 
+# NODE-category message types (reference: api/proto/node — the node
+# service's own wire types ride the same envelope)
+NODE_MSG_SLASH = 0x10  # body: one encoded slash.Record
+
+
 def pack_envelope(category: MessageCategory, msg_type: int, payload: bytes) -> bytes:
     return bytes([category, msg_type]) + payload
 

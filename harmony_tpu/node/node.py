@@ -53,9 +53,11 @@ from ..core import rawdb
 from ..core.blockchain import ChainError
 from ..log import get_logger
 from ..multibls import PrivateKeys
-from ..p2p import consensus_topic
+from ..p2p import consensus_topic, slash_topic
 from ..p2p.host import ACCEPT, IGNORE, REJECT
+from ..staking import slash as SL
 from .ingress import (
+    NODE_MSG_SLASH,
     VIEW_ID_WINDOW,
     IngressContext,
     MessageCategory,
@@ -89,7 +91,19 @@ class Node:
         self.view_changes = 0  # view-change votes this node started
         self.new_views_adopted = 0  # NEWVIEW adoptions (chaos metrics)
         self.webhooks = registry.get("webhooks")
-        self.pending_double_signs: list = []  # evidence for proposals
+        self.pending_double_signs: list = []  # forensic evidence dicts
+        self.double_sign_events = 0  # conflicts detected (any phase)
+        self.double_signs_dropped = 0  # evidence lost to the queue cap
+        self._ds_drop_logged = False  # log the cap overflow ONCE
+        # block-includable slash.Record queue (commit-phase evidence
+        # only — the phase the reference slashes on), fed by local
+        # detection AND the slash gossip topic; drained into proposals
+        self.pending_slash_records: list = []
+        self._slash_seen: set = set()  # evidence fingerprints (bounded)
+        # (block_num, view, hash, commit_view, commit store, payload
+        # fn) of the last round this node led to commit quorum — the
+        # late-ballot detection window (_check_double_sign via _handle)
+        self._prev_commit_ctx = None
         # durable last-signed-view state: written through the chain DB
         # BEFORE any vote leaves this node, reloaded here on restart —
         # a hard-killed validator can neither double-sign its last
@@ -132,8 +146,22 @@ class Node:
                 "announce-to-commit wall time of one FBFT round",
             ) if mreg is not None else None
         )
+        self._ds_dropped_metric = (
+            mreg.counter(
+                "harmony_consensus_double_sign_dropped_total",
+                "double-sign evidence records lost to the bounded "
+                "pending queue (cap overflow after duplicate eviction)",
+            ) if mreg is not None else None
+        )
         self.host.add_validator(self.topic, self._gossip_validator)
         self.host.subscribe(self.topic, self._on_gossip)
+        # double-sign evidence gossip: detection usually happens at the
+        # round leader, but the NEXT leader is who proposes — records
+        # flood this topic (cheap bounded-decode validator; the pairing
+        # verification runs on the pump) so any node can include them
+        self._slash_topic = slash_topic(network, self.chain.shard_id)
+        self.host.add_validator(self._slash_topic, self._slash_validator)
+        self.host.subscribe(self._slash_topic, self._on_gossip)
         # live cross-shard receipt routing (reference:
         # node_cross_shard.go BroadcastCXReceipts / ProcessReceiptMessage):
         # in a multi-shard topology each committed block's outgoing
@@ -214,6 +242,22 @@ class Node:
     # -- round lifecycle ----------------------------------------------------
 
     def _new_round(self):
+        # one-round forensic memory (the role of the reference's FBFT
+        # log spanning rounds): a conflicting COMMIT ballot often
+        # arrives RIGHT BEHIND the honest tipping vote, i.e. after the
+        # leader already committed and reset — without this stash the
+        # equivocator wins the race against its own evidence
+        prev_leader = getattr(self, "leader", None)
+        if (prev_leader is not None
+                and prev_leader.current_block_hash is not None
+                and prev_leader.commit_sigs):
+            self._prev_commit_ctx = (
+                self.block_num, self.view_id,
+                prev_leader.current_block_hash,
+                prev_leader.cfg.commit_view_id,
+                dict(prev_leader.commit_sigs),
+                prev_leader._commit_payload,
+            )
         # close any trace spans left from the previous round (a round
         # that COMMITTED already finished them; this is the abandoned
         # path — view change or sync rejoin)
@@ -322,6 +366,21 @@ class Node:
         result = validate_consensus_message(msg, ctx, self.chain.shard_id)
         return ACCEPT if result.accepted else IGNORE
 
+    def _slash_validator(self, payload: bytes, frm: str) -> int:
+        """Cheap structural gate on slash-topic gossip (no crypto —
+        that runs on the pump): a frame that isn't one well-formed
+        bounded record is punishable junk."""
+        try:
+            category, msg_type, body = parse_envelope(payload)
+            if category != MessageCategory.NODE or (
+                msg_type != NODE_MSG_SLASH
+            ):
+                return REJECT
+            SL.decode_record(body)
+        except (ValueError, IndexError):
+            return REJECT
+        return ACCEPT
+
     def _on_gossip(self, topic: str, payload: bytes, frm: str):
         self._queue.put(payload)
 
@@ -391,7 +450,9 @@ class Node:
                 vrf = proof
             incoming = self.cx_pool.drain() if self.cx_pool else None
             block = self.worker.propose_block(
-                view_id=self.view_id, vrf=vrf, incoming_receipts=incoming
+                view_id=self.view_id, vrf=vrf,
+                incoming_receipts=incoming,
+                slashes=self._includable_slashes(),
             )
         block_bytes = rawdb.encode_block(block, self.chain.config.chain_id)
         # the announce carries the leader's own prepare signature:
@@ -497,7 +558,11 @@ class Node:
 
     def _handle(self, payload: bytes):
         try:
-            category, _, body = parse_envelope(payload)
+            category, msg_type, body = parse_envelope(payload)
+            if category == MessageCategory.NODE:
+                if msg_type == NODE_MSG_SLASH:
+                    self._on_slash_record(body)
+                return
             if category != MessageCategory.CONSENSUS:
                 return
             msg = decode_message(body)
@@ -511,6 +576,24 @@ class Node:
                 self._ahead_runs += 1
                 if self._ahead_runs >= self.ahead_threshold:
                     self._spin_up_sync()
+            elif (
+                msg.msg_type == MsgType.COMMIT
+                and self._prev_commit_ctx is not None
+                and msg.block_num == self._prev_commit_ctx[0]
+            ):
+                # late-ballot forensics: a conflicting COMMIT for the
+                # round this node JUST led to quorum typically arrives
+                # right behind the tipping honest vote — after the
+                # commit reset.  The stashed round context keeps the
+                # equivocator from winning that race (cheap key-overlap
+                # check gates the pairing work, so stale junk is free).
+                pnum, pview, phash, pcv, pstore, ppayload = (
+                    self._prev_commit_ctx
+                )
+                self._check_double_sign(
+                    msg, pstore, ppayload, phase="commit",
+                    ctx=(pnum, pview, phash, pcv),
+                )
             return
         self._ahead_runs = 0
         try:
@@ -628,6 +711,14 @@ class Node:
                 group_cx_by_shard(result.outgoing_cx)
             ) != header.out_cx_root:
                 return None
+            # included slash records re-verify against the moment's
+            # epoch committee BEFORE this node votes: a leader packing
+            # a forged/duplicate record loses the round, not the
+            # network (the applied effect also feeds the root check)
+            self.chain.apply_slashes(
+                state, header.slashes, header.block_num,
+                observe=False, version=header.version,
+            )
             self.chain.post_process(
                 state, header.block_num, header.epoch,
                 header.last_commit_bitmap or None,
@@ -736,18 +827,32 @@ class Node:
                 self._broadcast(committed, retry=True)
                 self._commit_block(committed)
 
-    def _check_double_sign(self, msg: FBFTMessage, store, payload_for):
+    def _check_double_sign(self, msg: FBFTMessage, store, payload_for,
+                           phase: str = "prepare", ctx=None):
         """Leader-side equivocation detection (reference:
         consensus/double_sign.go:16 checkDoubleSign).  Evidence needs
-        BOTH signed votes from the same key THIS round: the stored vote
-        for the announced block plus a verified conflicting vote for a
-        different hash at the same (height, view) — a delayed vote from
-        another view, or unsigned junk, must not frame anyone."""
+        BOTH signed votes from the same key in ONE round: the stored
+        vote for the announced block plus a verified conflicting vote
+        for a different hash at the same (height, view) — a delayed
+        vote from another view, or unsigned junk, must not frame
+        anyone.  ``ctx`` supplies a PAST round's (block_num, view,
+        hash, commit_view) for the late-ballot window; default is the
+        live round.
+
+        Commit-phase conflicts additionally become block-includable
+        ``slash.Record``s (the phase the reference slashes on) — queued
+        for this node's next proposal AND published on the slash gossip
+        topic so whoever leads next can include them."""
+        if ctx is None:
+            ctx = (self.block_num, self.view_id,
+                   self.leader.current_block_hash,
+                   self.leader.cfg.commit_view_id)
+        block_num, view_id, block_hash, commit_view = ctx
         if (
-            self.leader.current_block_hash is None
-            or msg.block_hash == self.leader.current_block_hash
-            or msg.view_id != self.view_id
-            or msg.block_num != self.block_num
+            block_hash is None
+            or msg.block_hash == block_hash
+            or msg.view_id != view_id
+            or msg.block_num != block_num
             or not msg.sender_pubkeys
         ):
             return
@@ -775,20 +880,208 @@ class Node:
             "view_id": msg.view_id,
             "shard_id": self.chain.shard_id,
             "keys": [pk.hex() for pk in msg.sender_pubkeys],
-            "first_hash": self.leader.current_block_hash.hex(),
+            "first_hash": block_hash.hex(),
             "first_keys": [pk.hex() for pk in first[0]],
             "first_signature": first[1].bytes.hex(),
             "second_hash": msg.block_hash.hex(),
             "second_signature": msg.payload.hex(),
         }
-        if len(self.pending_double_signs) < 64:
-            self.pending_double_signs.append(evidence)
+        self.double_sign_events += 1
+        SL.COUNTERS.inc("detected")
+        self._queue_forensic_evidence(evidence)
         self.log.warn(
             "double sign detected", height=msg.block_num,
-            view=msg.view_id, keys=len(msg.sender_pubkeys),
+            view=msg.view_id, keys=len(msg.sender_pubkeys), phase=phase,
         )
         if self.webhooks is not None:
             self.webhooks.fire("double_sign", evidence)
+        if phase == "commit":
+            record = self._build_slash_record(
+                msg, first, block_hash, commit_view,
+            )
+            if record is not None and self._queue_slash_record(record):
+                # flood the evidence: the dedup fingerprint makes
+                # repeats free on every receiver
+                self.host.publish(self._slash_topic, pack_envelope(
+                    MessageCategory.NODE, NODE_MSG_SLASH,
+                    SL.encode_record(record),
+                ))
+
+    def _queue_forensic_evidence(self, evidence: dict):
+        """Bounded forensic queue: at the cap, evict a DUPLICATE (same
+        offender keys at the same moment — re-delivered conflicting
+        votes) before ever dropping a fresh offender; an actual drop is
+        logged once and counted."""
+        if len(self.pending_double_signs) >= 64:
+            dup_key = (evidence["height"], evidence["view_id"],
+                       tuple(evidence["keys"]))
+            for i, old in enumerate(self.pending_double_signs):
+                if (old["height"], old["view_id"],
+                        tuple(old["keys"])) == dup_key:
+                    self.pending_double_signs.pop(i)
+                    break
+            else:
+                self.double_signs_dropped += 1
+                if self._ds_dropped_metric is not None:
+                    self._ds_dropped_metric.inc()
+                if not self._ds_drop_logged:
+                    self._ds_drop_logged = True
+                    self.log.error(
+                        "double-sign evidence queue full: dropping "
+                        "new evidence (logged once; see "
+                        "harmony_consensus_double_sign_dropped_total)",
+                        cap=64,
+                    )
+                return
+        self.pending_double_signs.append(evidence)
+
+    def _address_of_key(self, key: bytes, epoch: int):
+        """(validator address, staked) for a committee BLS key: the
+        elected shard state's slot when one exists (its address is what
+        a slash applies to), else the finalizer's Harmony-operated
+        account table, else None (pre-staking chains have no address to
+        slash — evidence stays forensic)."""
+        shard_state = self.chain.shard_state_for_epoch(epoch)
+        if shard_state is not None:
+            com = shard_state.find_committee(self.chain.shard_id)
+            if com is not None:
+                for slot in com.slots:
+                    if slot.bls_pubkey == key:
+                        return (slot.ecdsa_address,
+                                slot.effective_stake is not None)
+        fin = self.chain.finalizer
+        if fin is not None:
+            for addr, pub in fin.cfg.harmony_accounts:
+                if pub == key:
+                    return addr, False
+        return None, False
+
+    def _build_slash_record(self, msg: FBFTMessage, first,
+                            block_hash: bytes, commit_view: int):
+        """Assemble a verifiable Record from a commit-phase conflict.
+        The offender is the STAKED validator behind a double-signing
+        key (preferred over Harmony-operated slots — those hold no
+        slashable stake); None when no overlap key resolves to an
+        address distinct from this node's own (self-reports are
+        invalid by construction)."""
+        epoch = self.chain.epoch_of(msg.block_num)
+        overlap = [pk for pk in msg.sender_pubkeys if pk in first[0]]
+        offender = None
+        for want_staked in (True, False):
+            for pk in overlap:
+                addr, staked = self._address_of_key(pk, epoch)
+                if addr is not None and staked == want_staked:
+                    offender = addr
+                    break
+            if offender is not None:
+                break
+        if offender is None:
+            return None
+        reporter = b"\x00" * 20
+        if self._round_keys:
+            addr, _ = self._address_of_key(
+                self._round_keys[0].pub.bytes, epoch
+            )
+            if addr is not None:
+                reporter = addr
+        if reporter == offender:
+            return None  # a self-report never verifies
+        record = SL.Record(
+            evidence=SL.Evidence(
+                moment=SL.Moment(
+                    epoch=epoch, shard_id=self.chain.shard_id,
+                    height=msg.block_num,
+                    view_id=commit_view,
+                ),
+                first_vote=SL.Vote(
+                    signer_pubkeys=list(first[0]),
+                    block_header_hash=block_hash,
+                    signature=first[1].bytes,
+                ),
+                second_vote=SL.Vote(
+                    signer_pubkeys=list(msg.sender_pubkeys),
+                    block_header_hash=msg.block_hash,
+                    signature=msg.payload,
+                ),
+                offender=offender,
+            ),
+            reporter=reporter,
+        )
+        try:
+            SL.verify_record(
+                record, self.chain.committee_for_epoch(epoch),
+                is_staking=self.chain.config.is_staking(epoch),
+            )
+        except SL.SlashVerifyError as e:
+            self.log.warn("assembled slash record invalid", err=str(e))
+            return None
+        return record
+
+    def _queue_slash_record(self, record) -> bool:
+        """Dedup + bound the includable queue; True if newly queued."""
+        fp = SL.record_fingerprint(record)
+        if fp in self._slash_seen:
+            return False
+        if len(self.pending_slash_records) >= 64:
+            # NOT marked seen: evidence shed at a full queue must stay
+            # ingestible when the queue drains and the record re-floods
+            SL.COUNTERS.inc("dropped")
+            return False
+        if len(self._slash_seen) > 4096:
+            self._slash_seen.clear()  # bounded; re-gossip re-dedups
+        self._slash_seen.add(fp)
+        self.pending_slash_records.append(record)
+        SL.COUNTERS.inc("queued")
+        return True
+
+    def _on_slash_record(self, body: bytes):
+        """Slash-topic pump handler: bounded decode, full evidence
+        verification against the moment's committee, then queue for
+        this node's next proposal."""
+        try:
+            record = SL.decode_record(body)
+        except (ValueError, IndexError):
+            return
+        m = record.evidence.moment
+        if m.shard_id != self.chain.shard_id:
+            return
+        if m.epoch > self.chain.epoch_of(self.block_num):
+            return  # from the future: cannot resolve a committee yet
+        if SL.record_fingerprint(record) in self._slash_seen:
+            return  # dedup BEFORE the pairing work: replaying one
+            # valid record in a loop must cost a hash, not two
+            # aggregate verifications per copy
+        try:
+            SL.verify_record(
+                record, self.chain.committee_for_epoch(m.epoch),
+                is_staking=self.chain.config.is_staking(m.epoch),
+            )
+        except SL.SlashVerifyError as e:
+            SL.COUNTERS.inc("rejected")
+            self.log.warn("gossiped slash record rejected", err=str(e))
+            return
+        SL.COUNTERS.inc("gossip_received")
+        if self._queue_slash_record(record):
+            self.log.warn(
+                "slash evidence received via gossip",
+                height=m.height, view=m.view_id,
+            )
+
+    def _includable_slashes(self) -> list:
+        """The pending records this proposal should carry: still
+        unapplied (offender not yet banned) against the CURRENT state.
+        Records consumed by a competing leader's block stay filtered
+        here and age out of the bounded queue."""
+        state = self.chain.state()
+        out = []
+        for r in self.pending_slash_records:
+            if len(out) >= SL.MAX_SLASHES_PER_BLOCK:
+                break
+            w = state.validator(r.evidence.offender)
+            if w is None or w.status == 2:
+                continue
+            out.append(r)
+        return out
 
     def drain_double_signs(self) -> list:
         """Hand collected evidence to the slash pipeline (proposal
@@ -848,7 +1141,8 @@ class Node:
             return
         if not self.leader.on_commit(msg):
             self._check_double_sign(
-                msg, self.leader.commit_sigs, self.leader._commit_payload
+                msg, self.leader.commit_sigs,
+                self.leader._commit_payload, phase="commit",
             )
         self._leader_advance()
 
@@ -906,6 +1200,16 @@ class Node:
                 )
         if self.pool is not None:
             self.pool.drop_applied()
+        if self.pending_slash_records:
+            # purge records the chain has consumed (offender banned by
+            # this or a competing leader's block): the bounded queue
+            # must not silt up with already-applied evidence
+            state = self.chain.state()
+            self.pending_slash_records = [
+                r for r in self.pending_slash_records
+                if (w := state.validator(r.evidence.offender)) is not None
+                and w.status != 2
+            ]
         self.sender.stop_retry(block.block_num)
         if self.shard_count > 1 and self.is_leader:
             # sender-side restricted, as the reference's

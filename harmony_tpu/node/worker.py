@@ -36,13 +36,19 @@ class Worker:
         max_txs: int = DEFAULT_BLOCK_TX_CAP,
         vrf: bytes = b"",
         vdf: bytes = b"",
+        slashes: list | None = None,
     ) -> Block:
         """Assemble the next block on the current tip.
 
         Mempool selection is best-effort: a tx that fails execution is
         skipped (and left for the pool's next prune), exactly as the
         reference's worker drops failing txs from the proposal rather
-        than aborting it.
+        than aborting it.  ``slashes`` are verified double-sign
+        ``slash.Record``s to include: each is dry-applied first and
+        DROPPED from the proposal if it no longer applies (offender
+        already banned by a competing block, evidence gone stale) —
+        the proposer must never seal a block its own validators would
+        reject.
         """
         parent = self.chain.current_header()
         num = parent.block_num + 1
@@ -88,6 +94,39 @@ class Worker:
         for proof in incoming_receipts or []:
             for cx in proof.receipts:
                 self.chain.processor.apply_incoming_receipt(state, cx)
+        # double-sign slash inclusion (reference: the leader packs
+        # pending slashing records into the proposal — node.go
+        # ProposeNewBlock's slash candidate drain): dry-apply each on a
+        # throwaway copy so a record another block already consumed
+        # (offender banned) is silently dropped, then apply the
+        # surviving set for real — validators and replay re-run exactly
+        # this via Blockchain.apply_slashes on header.slashes
+        included_slashes: list = []
+        from ..staking import slash as _SL
+
+        if self.chain.config.header_version(epoch) != "v3":
+            slashes = None  # only v3 headers HASH the slashes field
+        if slashes:
+            # ONE running dry state: each candidate verifies + applies
+            # on top of the already-accepted set, so duplicates and
+            # same-offender repeats fail "already banned" without
+            # per-record full-state copies
+            dry = state.copy()
+            for record in slashes[:_SL.MAX_SLASHES_PER_BLOCK]:
+                try:
+                    self.chain.apply_slash_records(
+                        dry, [record], num, observe=False
+                    )
+                except ValueError:
+                    _SL.COUNTERS.inc("rejected")
+                    continue
+                included_slashes.append(record)
+        if included_slashes:
+            # observe=False: the proposal is speculative until it
+            # commits — the insert path counts the ONE real apply
+            self.chain.apply_slash_records(state, included_slashes, num,
+                                           observe=False)
+            _SL.COUNTERS.inc("included", len(included_slashes))
         # the parent's quorum proof rides in this header (reference:
         # block/header LastCommitSignature/Bitmap) and drives reward +
         # availability finalization
@@ -124,6 +163,8 @@ class Worker:
             # committees from here instead of trusting sync peers
             shard_state=(rawdb.encode_shard_state(elected)
                          if elected is not None else b""),
+            slashes=(_SL.encode_records(included_slashes)
+                     if included_slashes else b""),
             extra=leader_extra,
             vrf=vrf,
             vdf=vdf,

@@ -15,7 +15,7 @@ crypto batch, not the socket.
 """
 
 from .gating import Gater
-from .groups import GroupID, consensus_topic, node_topic
+from .groups import GroupID, consensus_topic, node_topic, slash_topic
 from .host import Host, InProcessNetwork, TCPHost
 
 __all__ = [
@@ -26,4 +26,5 @@ __all__ = [
     "TCPHost",
     "consensus_topic",
     "node_topic",
+    "slash_topic",
 ]

@@ -37,3 +37,10 @@ def client_topic(network: str, shard_id: int) -> str:
 def crosslink_topic(network: str) -> str:
     """Beacon-chain bound (shard 0) cross-link submissions."""
     return GroupID(network, 0, "crosslink").topic()
+
+
+def slash_topic(network: str, shard_id: int) -> str:
+    """Double-sign evidence gossip (the reference publishes slashing
+    candidates so non-leader observers aren't silenced; records dedup
+    by evidence fingerprint on receipt)."""
+    return GroupID(network, shard_id, "slash").topic()

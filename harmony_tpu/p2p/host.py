@@ -28,12 +28,39 @@ import time
 from collections import OrderedDict
 
 from ..log import get_logger
+from ..metrics import LockedCounters
 from ..ref.keccak import keccak256
 from .gating import Gater
 
 _log = get_logger("p2p")
 
 MAX_MESSAGE_BYTES = 2 * 1024 * 1024  # reference: p2p/host.go:98-99
+
+# hostile-wire observability (exposed as harmony_p2p_* via
+# metrics.Registry): invalid-message verdicts per transport, the
+# throttle/drop/ban ladder, and the worst per-peer score ever
+# observed per host (a low-water mark — it does not recover when the
+# offending peer disconnects or decays back)
+P2P_COUNTERS = LockedCounters(
+    "invalid_inproc", "invalid_tcp", "throttled", "conns_dropped",
+    "ips_banned", "peers_muted",
+)
+_WORST_LOCK = threading.Lock()
+_WORST_SCORE: dict[str, float] = {}  # host name -> worst live peer score
+
+
+def _note_score(host_name: str, score: float):
+    with _WORST_LOCK:
+        cur = _WORST_SCORE.get(host_name, 0.0)
+        _WORST_SCORE[host_name] = min(cur, score)
+        if len(_WORST_SCORE) > 256:  # cardinality bound
+            _WORST_SCORE.pop(next(iter(_WORST_SCORE)))
+
+
+def worst_peer_scores() -> dict:
+    """Snapshot for metrics exposition (harmony_p2p_peer_score)."""
+    with _WORST_LOCK:
+        return dict(_WORST_SCORE)
 _FRAME = struct.Struct("<IB")
 _KIND_PUBLISH = 1
 _KIND_HELLO = 2
@@ -195,12 +222,25 @@ class Host:
 
 
 class InProcessNetwork:
-    """Hub connecting InProcess hosts (deterministic, synchronous)."""
+    """Hub connecting InProcess hosts (deterministic, synchronous).
+
+    Carries the same invalid-message scoring ladder as TCPHost (the
+    gossipsub score function's role) so in-process Byzantine scenarios
+    exercise the REAL defense: every REJECT verdict scores the sender
+    down; past ``THROTTLE_FLOOR`` only every other message is routed;
+    past ``MUTE_FLOOR`` the sender is muted off the hub entirely."""
+
+    THROTTLE_FLOOR = -24.0
+    MUTE_FLOOR = -60.0
 
     def __init__(self):
         self._hosts: list = []
         self._lock = threading.Lock()
         self.partitioned: set = set()  # names cut off (failure injection)
+        self.muted: set = set()        # names dropped for spam
+        self.scores: dict[str, float] = {}
+        self._throttle_ctr: dict[str, int] = {}
+        self.invalid_total = 0         # REJECT verdicts observed
 
     def host(self, name: str) -> "_InProcessHost":
         h = _InProcessHost(name, self)
@@ -223,6 +263,16 @@ class InProcessNetwork:
         if frm in self.partitioned:
             return
         with self._lock:
+            if frm in self.muted:
+                return  # dropped for spam: nothing propagates
+            if self.scores.get(frm, 0.0) <= self.THROTTLE_FLOOR:
+                # rate-limit tier: a misbehaving-but-not-yet-dropped
+                # sender gets every other message routed
+                n = self._throttle_ctr.get(frm, 0) + 1
+                self._throttle_ctr[frm] = n
+                if n % 2:
+                    P2P_COUNTERS.inc("throttled")
+                    return
             hosts = list(self._hosts)
         # no dedup on the hub: it is single-hop (each publish visits
         # each host exactly once, no multipath to suppress), and
@@ -232,11 +282,36 @@ class InProcessNetwork:
         # dead on arrival for ~50 s until cache eviction.  libp2p ids
         # are (sender, seqno): every publish is a fresh message —
         # TCPHost stamps the same semantics into its PUBLISH bodies.
+        rejects = 0
         for h in hosts:
             if h.name == frm or h.name in self.partitioned:
                 continue
-            if h._validate(topic, payload, frm) == ACCEPT:
+            verdict = h._validate(topic, payload, frm)
+            if verdict == ACCEPT:
                 h._deliver(topic, payload, frm)
+            elif verdict == REJECT:
+                rejects += 1
+        if rejects:
+            self._punish(frm, rejects)
+
+    def _punish(self, frm: str, rejects: int):
+        """Score a sender down for REJECT verdicts (malformed/bogus
+        bytes — IGNORE stays free, exactly the TCPHost contract)."""
+        P2P_COUNTERS.inc("invalid_inproc", rejects)
+        with self._lock:
+            self.invalid_total += rejects
+            score = self.scores.get(frm, 0.0) - float(rejects)
+            self.scores[frm] = score
+            if len(self.scores) > 1024:
+                self.scores.pop(next(iter(self.scores)))
+            mute = score <= self.MUTE_FLOOR and frm not in self.muted
+            if mute:
+                self.muted.add(frm)
+        _note_score(f"hub:{frm}", score)
+        if mute:
+            P2P_COUNTERS.inc("peers_muted")
+            _log.warn("hub peer muted for spam", peer=frm,
+                      score=round(score, 1))
 
 
 class _InProcessHost(Host):
@@ -267,6 +342,9 @@ class TCPHost(Host):
     VALIDATE_QUEUE_CAP = 8192  # reference: p2p/host.go maxSize
     VALIDATE_WORKERS = 4
     SCORE_FLOOR = -20.0
+    THROTTLE_FLOOR = -10.0  # rate-limit tier BEFORE the drop: half of
+    #                         a misbehaving peer's messages shed at
+    #                         ingress while its score still decays back
     SCORE_DECAY_PER_S = 0.5  # forgiveness rate for honest mistakes
     # mesh degree bounds (gossipsub's D/D_lo/D_hi): eager push goes to
     # at most MESH_D_HI peers per topic; everyone else gets lazy IHAVE
@@ -310,6 +388,7 @@ class TCPHost(Host):
         self.dropped_overflow = 0  # messages shed at the full queue
         self._score_lock = threading.Lock()
         self._scores: dict[int, tuple[float, float]] = {}  # sockid->(s,at)
+        self._throttle_ctr: dict[int, int] = {}  # sockid -> msg counter
         self._ip_strikes: dict[str, int] = {}  # floor hits per address
         # mesh state (under _peer_lock): per-topic eager-push peer sets,
         # per-peer announced topic sets (None until first SUBS =
@@ -491,6 +570,7 @@ class TCPHost(Host):
             self._msg_limiter.drop(str(id(sock)))
             with self._score_lock:
                 self._scores.pop(id(sock), None)
+                self._throttle_ctr.pop(id(sock), None)
             # an in-flight flood can setdefault a lock back after the
             # pop above; prune stale ids when churn accumulates them
             if len(self._send_locks) > 2 * len(live) + 16:
@@ -531,6 +611,28 @@ class TCPHost(Host):
             with self._score_lock:
                 self.dropped_rate_limited += 1
             return  # NOT marked seen: another (slower) peer may relay
+        now = time.monotonic()
+        with self._score_lock:
+            throttled = False
+            ent = self._scores.get(id(src_sock))
+            if ent is not None:
+                # apply the forgiveness decay on the READ path too —
+                # a peer that stopped misbehaving must throttle out of
+                # the tier by time alone, not by misbehaving again
+                score, at = ent
+                score = min(
+                    0.0, score + (now - at) * self.SCORE_DECAY_PER_S
+                )
+                self._scores[id(src_sock)] = (score, now)
+                if score <= self.THROTTLE_FLOOR:
+                    # throttle tier: a peer feeding garbage loses half
+                    # its ingress before the score floor drops it
+                    n = self._throttle_ctr.get(id(src_sock), 0) + 1
+                    self._throttle_ctr[id(src_sock)] = n
+                    throttled = bool(n % 2)
+        if throttled:
+            P2P_COUNTERS.inc("throttled")
+            return
         mid = keccak256(body)
         if self._seen.seen(mid):
             return
@@ -598,23 +700,28 @@ class TCPHost(Host):
         and never applied to loopback, so shared-IP peers aren't
         collaterally refused."""
         now = time.monotonic()
+        P2P_COUNTERS.inc("invalid_tcp")
         with self._score_lock:
             score, at = self._scores.get(id(sock), (0.0, now))
             score = min(
                 0.0, score + (now - at) * self.SCORE_DECAY_PER_S
             ) - 1.0
             self._scores[id(sock)] = (score, now)
+        _note_score(self.name or "tcp", score)
         if score <= self.SCORE_FLOOR:
             with self._score_lock:
                 self._scores.pop(id(sock), None)
+                self._throttle_ctr.pop(id(sock), None)
                 strikes = self._ip_strikes.get(ip, 0) + 1
                 self._ip_strikes[ip] = strikes
+            P2P_COUNTERS.inc("conns_dropped")
             loopback = ip.startswith("127.") or ip in ("::1", "localhost")
             if strikes >= self.IP_BAN_STRIKES and not loopback:
                 _log.warn(
                     "ip banned for repeated spam", me=self.name, ip=ip,
                     strikes=strikes,
                 )
+                P2P_COUNTERS.inc("ips_banned")
                 self.gater.ban(ip)
             else:
                 _log.warn(

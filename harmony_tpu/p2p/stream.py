@@ -45,11 +45,25 @@ METHOD_ACCOUNT_RANGE = 6   # [u64 block][len-pfx start addr][u32 limit]
 #                            -> [u32 n][(addr, account blob)...]
 MAX_BLOCKS_PER_REQUEST = 128   # server-side clamp
 MAX_ACCOUNTS_PER_REQUEST = 512  # account-range clamp
+# wire plausibility bounds, checked BEFORE any allocation: every
+# request is a method byte + a handful of fixed fields (+ one short
+# address), and responses are assembled under the soft byte budget
+# below — a peer claiming more is feeding garbage and is dropped
+MAX_REQUEST_BYTES = 4096
+MAX_RESPONSE_BYTES = 32 * 1024 * 1024
+RESPONSE_SOFT_BUDGET = 8 * 1024 * 1024  # server stops packing past this
 
 
 def protocol_id(network: str, shard_id: int) -> str:
     """reference: protocol.go:86 — hmy/sync/<net>/<shard>/<version>."""
     return f"harmony-tpu/sync/{network}/{shard_id}/{PROTOCOL_VERSION}"
+
+
+def _checked_count(r: _Reader, width: int = 4) -> int:
+    """Bounded count for PEER response bodies — Reader.checked_count
+    (a forged count must cost its own wire size, never a
+    4-billion-iteration decode loop)."""
+    return r.checked_count(width)
 
 
 class SyncServer:
@@ -99,6 +113,8 @@ class SyncServer:
                 if hdr is None:
                     return
                 ln, kind, req_id = _HDR.unpack(hdr)
+                if ln > MAX_REQUEST_BYTES:
+                    return  # implausible request frame: drop the peer
                 body = _recv_exact(sock, ln)
                 if body is None or (kind & ~_TRACE_FLAG) != _REQ:
                     return
@@ -165,10 +181,14 @@ class SyncServer:
                     self._range_cache = (num, keys, everything)
             lo = bisect.bisect_right(keys, start_addr)
             items = everything[lo:lo + limit]
-            out = bytearray(_enc_int(len(items), 4))
+            body = bytearray()
+            n = 0
             for addr, blob in items:
-                out += _enc_bytes(addr) + _enc_bytes(blob)
-            return bytes(out)
+                body += _enc_bytes(addr) + _enc_bytes(blob)
+                n += 1
+                if len(body) > RESPONSE_SOFT_BUDGET:
+                    break  # short page: the client pages onward
+            return bytes(_enc_int(n, 4) + body)
         start = r.int_()
         count = min(r.int_(4), MAX_BLOCKS_PER_REQUEST)
         if method == METHOD_BLOCK_HASHES:
@@ -183,14 +203,18 @@ class SyncServer:
             # per-block receipt lists (reference: client.go GetReceipts
             # feeding the stagedstreamsync receipts stage)
             blobs = []
+            total = 0
             for num in range(start, start + count):
-                if num > self.chain.head_number:
+                if num > self.chain.head_number or (
+                    total > RESPONSE_SOFT_BUDGET
+                ):
                     break
                 receipts = rawdb.read_receipts(self.chain.db, num)
                 blob = bytearray(_enc_int(len(receipts), 4))
                 for rc in receipts:
                     blob += rc.encode()
                 blobs.append(bytes(blob))
+                total += len(blob)
             out = bytearray(_enc_int(len(blobs), 4))
             for blob in blobs:
                 out += _enc_bytes(blob)
@@ -198,9 +222,10 @@ class SyncServer:
         if method == METHOD_BLOCKS_BY_NUM:
             out = bytearray()
             blobs = []
+            total = 0
             for num in range(start, start + count):
                 block = self.chain.block_by_number(num)
-                if block is None:
+                if block is None or total > RESPONSE_SOFT_BUDGET:
                     break
                 blob = (
                     _enc_bytes(rawdb.encode_header(block.header))
@@ -210,6 +235,7 @@ class SyncServer:
                     + _enc_bytes(self.chain.read_commit_sig(num) or b"")
                 )
                 blobs.append(blob)
+                total += len(blob)
             out += _enc_int(len(blobs), 4)
             for blob in blobs:
                 out += _enc_bytes(blob)
@@ -316,6 +342,8 @@ class SyncClient:
             if hdr is None:
                 break
             ln, kind, rid = _HDR.unpack(hdr)
+            if ln > MAX_RESPONSE_BYTES:
+                break  # implausible frame: drop the stream, fail waiters
             body = _recv_exact(sock, ln)
             if body is None:
                 break
@@ -422,7 +450,7 @@ class SyncClient:
         )
         r = _Reader(resp)
         out = []
-        for _ in range(r.int_(4)):
+        for _ in range(_checked_count(r)):
             item = _Reader(r.bytes_())
             header = rawdb.decode_header(item.bytes_())
             txs, stxs, cxs, order = rawdb.decode_body(item.bytes_())
@@ -445,9 +473,10 @@ class SyncClient:
         )
         r = _Reader(resp)
         out = []
-        for _ in range(r.int_(4)):
+        for _ in range(_checked_count(r)):
             item = _Reader(r.bytes_())
-            out.append([Receipt.decode(item) for _ in range(item.int_(4))])
+            out.append([Receipt.decode(item)
+                        for _ in range(_checked_count(item))])
         return out
 
     def get_account_range(self, num: int, start_addr: bytes = b"",
@@ -464,6 +493,10 @@ class SyncClient:
         n = r.int_(4)
         if n == 0xFFFFFFFF:
             raise ConnectionError(f"peer has no state at block {num}")
+        if n > len(r.view) - r.off:
+            raise ValueError(
+                f"implausible account count {n} in sync response"
+            )  # same bound as checked_count; n was already consumed
         return [(r.bytes_(), r.bytes_()) for _ in range(n)]
 
     def get_epoch_state(self, epoch: int, deadline=None):

@@ -72,6 +72,7 @@ def epos_staked_committee(
     orders: dict,  # address -> effective.SlotOrder
     external_slots_total: int,
     extended_bound: bool = False,
+    exclude_keys=frozenset(),  # slashed keys barred from the auction
 ) -> State:
     """Build the epoch committee state: Harmony slots round-robin +
     EPoS auction winners sharded by key value."""
@@ -85,7 +86,7 @@ def epos_staked_committee(
         state.shards.append(com)
 
     _, winners = effective.apply(
-        orders, external_slots_total, extended_bound
+        orders, external_slots_total, extended_bound, exclude_keys
     )
     for w in winners:
         shard_id = int.from_bytes(w.key, "big") % shard_count

@@ -129,8 +129,18 @@ def build_set_committee(epoch: int, shard: int, pubkeys: list) -> bytes:
     return body
 
 
+def _unpack(fmt: str, body: bytes, off: int = 0):
+    """struct.unpack_from with the protocol's error contract: a short
+    body is a ValueError (typed wire garbage), never a struct.error
+    leaking into callers that only catch ValueError."""
+    try:
+        return struct.unpack_from(fmt, body, off)
+    except struct.error as e:
+        raise ValueError(f"truncated frame body: {e}") from e
+
+
 def parse_set_committee(body: bytes):
-    epoch, shard, n = struct.unpack_from("<QII", body)
+    epoch, shard, n = _unpack("<QII", body)
     off = 16
     if len(body) != off + 48 * n:
         raise ValueError("bad SET_COMMITTEE length")
@@ -153,12 +163,16 @@ def build_agg_verify(
 
 
 def parse_agg_verify(body: bytes):
-    epoch, shard, plen = struct.unpack_from("<QIH", body)
+    epoch, shard, plen = _unpack("<QIH", body)
     off = 14
+    if plen > len(body) - off:
+        raise ValueError("bad AGG_VERIFY length")
     payload = body[off : off + plen]
     off += plen
-    (blen,) = struct.unpack_from("<H", body, off)
+    (blen,) = _unpack("<H", body, off)
     off += 2
+    if blen > len(body) - off:
+        raise ValueError("bad AGG_VERIFY length")
     bitmap = body[off : off + blen]
     off += blen
     sig = body[off : off + 96]
@@ -178,14 +192,23 @@ def build_verify_batch(items: list) -> bytes:
 
 
 def parse_verify_batch(body: bytes):
-    (n,) = struct.unpack_from("<I", body)
+    (n,) = _unpack("<I", body)
     off = 4
+    # each item is >= 48 + 2 + 96 bytes: reject an inflated count
+    # BEFORE looping — a forged u32 must not allocate n tuples
+    if n * (48 + 2 + 96) > len(body) - off:
+        raise ValueError(
+            f"implausible VERIFY_BATCH count {n} for "
+            f"{len(body) - off} body bytes"
+        )
     items = []
     for _ in range(n):
         pk = body[off : off + 48]
         off += 48
-        (plen,) = struct.unpack_from("<H", body, off)
+        (plen,) = _unpack("<H", body, off)
         off += 2
+        if plen > len(body) - off:
+            raise ValueError("bad VERIFY_BATCH length")
         payload = body[off : off + plen]
         off += plen
         sig = body[off : off + 96]

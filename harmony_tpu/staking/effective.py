@@ -55,20 +55,26 @@ def median(purchases: list[SlotPurchase]) -> Dec:
     return ordered[n // 2].raw_stake
 
 
-def compute(orders: dict, pull: int):
+def compute(orders: dict, pull: int, exclude_keys=frozenset()):
     """(median, picks): expand orders into per-key slots, take top-``pull``
-    by raw stake."""
+    by raw stake.  ``exclude_keys`` drops individual BLS keys from the
+    auction regardless of whose order lists them — the slashed-key
+    exclusion (a double-sign offender's keys must not win a slot in the
+    next election even if re-registered under another order)."""
     if not orders:
         return zero_dec(), []
     slots: list[SlotPurchase] = []
     for addr in sorted(orders):  # deterministic address order
         order = orders[addr]
-        n = len(order.spread_among)
+        spread_among = [
+            k for k in order.spread_among if k not in exclude_keys
+        ]
+        n = len(spread_among)
         if n == 0:
             continue
         # QuoInt64 semantics: divide the raw representation, truncating
         spread = Dec(Dec.from_int(order.stake).raw // n)
-        for key in order.spread_among:
+        for key in spread_among:
             slots.append(
                 SlotPurchase(
                     addr=addr, key=key, raw_stake=spread, epos_stake=spread
@@ -87,9 +93,10 @@ def effective_stake(lo: Dec, hi: Dec, actual: Dec) -> Dec:
     return lo if lo.gt(capped) else capped
 
 
-def apply(orders: dict, pull: int, extended_bound: bool = False):
+def apply(orders: dict, pull: int, extended_bound: bool = False,
+          exclude_keys=frozenset()):
     """Full EPoS round: compute winners and clamp their effective stakes."""
-    med, picks = compute(orders, pull)
+    med, picks = compute(orders, pull, exclude_keys)
     c = C_BOUND_V2 if extended_bound else C_BOUND
     hi = _ONE.add(c).mul(med)
     lo = _ONE.sub(c).mul(med)

@@ -1,0 +1,261 @@
+"""Byzantine-validator behaviors against the production node (ISSUE 13):
+the ByzantineNode policy layer (equivocation, double votes, invalid
+proposals, vote withholding) driven through deterministic pump-mode
+localnets, plus the hostile-wire peer-scoring ladder on both transports."""
+
+import time
+
+import pytest
+
+from harmony_tpu.chaostest import fixtures as FX
+from harmony_tpu.chaostest.byzantine import ByzantineNode
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.node.node import Node
+from harmony_tpu.node.registry import Registry
+from harmony_tpu.p2p import InProcessNetwork, TCPHost
+from harmony_tpu.p2p.host import ACCEPT, REJECT
+from harmony_tpu.staking import slash as SL
+
+CHAIN_ID = 2
+
+
+def _localnet(n_nodes=4, byz_index=None, behaviors=(),
+              staking=False, blocks_per_epoch=16, ext_on=None):
+    """Pump-driven localnet; node ``byz_index`` is a ByzantineNode.
+    ``ext_on`` additionally rides a staked external BLS key on that
+    node index (registered via a staking tx in every pool)."""
+    genesis, ecdsa_keys, bls_keys = dev_genesis(
+        n_accounts=n_nodes, n_keys=n_nodes
+    )
+    net = InProcessNetwork()
+    ext = FX.external_bls_key(7) if ext_on is not None else None
+    nodes = []
+    for i in range(n_nodes):
+        chain = Blockchain(
+            MemKV(), genesis, blocks_per_epoch=blocks_per_epoch,
+            finalizer=(FX.staking_finalizer(genesis, ecdsa_keys)
+                       if staking else None),
+        )
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        if ext is not None:
+            pool.add(
+                FX.external_validator_stake(ecdsa_keys[0], ext,
+                                            chain_id=CHAIN_ID),
+                is_staking=True,
+            )
+        reg = Registry(blockchain=chain, txpool=pool,
+                       host=net.host(f"node{i}"))
+        keys = [bls_keys[i]]
+        if ext_on == i:
+            keys.append(ext)
+        if i == byz_index:
+            node = ByzantineNode(
+                reg, PrivateKeys.from_keys(keys),
+                behaviors=behaviors,
+                adversary_keys=({ext.pub.bytes} if ext is not None
+                                else None),
+                seed=5,
+            )
+        else:
+            node = Node(reg, PrivateKeys.from_keys(keys))
+        nodes.append(node)
+    return nodes, ecdsa_keys, (ext, net)
+
+
+def _pump(nodes, rounds=80):
+    for _ in range(rounds):
+        if not any(n.process_pending() for n in nodes):
+            break
+
+
+def _run_round(nodes):
+    leaders = [n for n in nodes if n.is_leader]
+    assert len(leaders) == 1
+    leaders[0].start_round_if_leader()
+    _pump(nodes)
+    return leaders[0]
+
+
+def test_double_voter_detected_included_applied():
+    """The acceptance arc, deterministic: a staked external key on the
+    byzantine node double-votes once elected; an honest leader detects
+    it (late-ballot window included), the record gossips, the next
+    honest leader INCLUDES it, every validator re-verifies, and the
+    finalized state shows the offender slashed+banned, the reporter
+    rewarded, and the key excluded from the next election."""
+    nodes, ecdsa_keys, (ext, net) = _localnet(
+        4, byz_index=2, behaviors=("double_vote",), staking=True,
+        blocks_per_epoch=4, ext_on=2,
+    )
+    byz = nodes[2]
+    offender = ecdsa_keys[0].address()  # the ext validator's staker
+    stake0 = 10**20
+    honest = [n for n in nodes if n is not byz]
+
+    for _ in range(8):
+        _run_round(nodes)
+
+    chain = honest[0].chain
+    assert chain.head_number >= 7
+    assert byz.byz_actions["double_vote"] >= 1
+    assert sum(n.double_sign_events for n in honest) >= 1
+    included = [
+        n for n in range(1, chain.head_number + 1)
+        if chain.header_by_number(n).slashes
+    ]
+    assert included, "no committed block carried the slash record"
+    rec = SL.decode_records(
+        chain.header_by_number(included[0]).slashes
+    )[0]
+    assert rec.evidence.offender == offender
+    w = chain.state().validator(offender)
+    assert w.status == 2
+    assert stake0 - w.total_delegation() == SL.apply_slash(
+        stake0
+    ).total_slashed
+    # reporter (an honest dev account) credited above its allocation
+    assert chain.state().balance(rec.reporter) > 10**24
+    # post-ban election excludes the slashed key; honest heads agree
+    assert ext.pub.bytes not in chain.committee_for_epoch(2)
+    common = min(n.chain.head_number for n in honest)
+    assert len({
+        n.chain.block_by_number(common).hash() for n in honest
+    }) == 1
+
+
+def test_equivocating_leader_absorbed_by_first_announce_wins():
+    """Twin-second equivocation: honest validators vote the FIRST
+    announce only, the round commits one block, no honest node forks."""
+    nodes, _, _ = _localnet(4, byz_index=1, behaviors=("equivocate",))
+    byz = nodes[1]
+    assert byz.is_leader  # view 1 -> committee key 1
+    _run_round(nodes)
+    assert byz.byz_actions["equivocate"] == 1
+    honest = [n for n in nodes if n is not byz]
+    assert all(n.chain.head_number == 1 for n in honest)
+    assert len({n.chain.block_by_number(1).hash()
+                for n in honest}) == 1
+
+
+def test_equivocating_twin_first_wedges_but_never_forks():
+    """Twin-FIRST equivocation: the committee prepares the twin while
+    the leader's collector only counts the real block — the round must
+    WEDGE (no commit) rather than fork."""
+    nodes, _, _ = _localnet(4, byz_index=1, behaviors=("equivocate",))
+    byz = nodes[1]
+    byz.byz_actions["equivocate"] = 1  # force the twin-first posture
+    _run_round(nodes)
+    honest = [n for n in nodes if n is not byz]
+    assert all(n.chain.head_number == 0 for n in honest)  # wedged
+    # every honest validator voted for exactly one proposal
+    assert all(n._announce_voted is not None for n in honest)
+
+
+def test_withholding_validator_follows_without_voting():
+    nodes, _, _ = _localnet(4, byz_index=3, behaviors=("withhold",))
+    byz = nodes[3]
+    _run_round(nodes)
+    # 3-of-4 keys still meet quorum; the withholder FOLLOWS the chain
+    assert all(n.chain.head_number == 1 for n in nodes)
+    assert byz.byz_actions["withhold"] >= 1
+    # and its key is absent from the commit bitmap evidence: the round
+    # committed with exactly the honest signers
+    proof = nodes[0].chain.read_commit_sig(1)
+    assert proof is not None
+
+
+def test_invalid_proposals_rejected_by_every_validator():
+    nodes, _, _ = _localnet(4, byz_index=1,
+                            behaviors=("invalid_proposal",))
+    byz = nodes[1]
+    assert byz.is_leader
+    byz.start_round_if_leader()
+    _pump(nodes)
+    assert byz.byz_actions["invalid_proposal"] == 1
+    honest = [n for n in nodes if n is not byz]
+    # nobody voted for the garbage: no head moved, no prepare cast
+    assert all(n.chain.head_number == 0 for n in honest)
+    assert all(n._announce_voted is None for n in honest)
+
+
+# -- hostile-wire scoring ladder ---------------------------------------------
+
+
+def test_hub_scores_throttles_then_mutes_spammer():
+    from harmony_tpu.p2p.host import P2P_COUNTERS
+
+    net = InProcessNetwork()
+    evil = net.host("evil")
+    good = net.host("good")
+    victim = net.host("victim")
+    victim.add_validator("t", lambda p, f: REJECT)
+    victim.subscribe("t", lambda t, p, f: None)
+    throttled0 = P2P_COUNTERS["throttled"]
+    for i in range(100):
+        evil.publish("t", b"junk-%d" % i)
+        if "evil" in net.muted:
+            break
+    assert "evil" in net.muted
+    assert net.invalid_total >= 20
+    assert net.scores["evil"] <= net.MUTE_FLOOR
+    assert P2P_COUNTERS["throttled"] > throttled0  # the middle tier
+    # muted: nothing further routes, honest peers unaffected
+    seen = []
+    victim.add_validator("ok", lambda p, f: ACCEPT)
+    victim.subscribe("ok", lambda t, p, f: seen.append((p, f)))
+    evil.publish("ok", b"from-evil")
+    good.publish("ok", b"from-good")
+    assert seen == [(b"from-good", "good")]
+
+
+def test_tcp_peer_throttled_then_dropped_for_spam():
+    h1 = TCPHost("defender")
+    h2 = TCPHost("spammer")
+    try:
+        h1.add_validator("x", lambda p, f: REJECT)
+        h1.subscribe("x", lambda t, p, f: None)
+        h2.connect(h1.port)
+        assert h1.wait_for_peers(1) and h2.wait_for_peers(1)
+        for i in range(80):
+            h2.publish("x", b"junk-%d" % i)
+            if h1.peer_count() == 0:
+                break
+            time.sleep(0.01)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and h1.peer_count():
+            time.sleep(0.05)
+        assert h1.peer_count() == 0, "spamming peer was not dropped"
+    finally:
+        h1.close(), h2.close()
+
+
+def test_p2p_and_slash_metrics_exposed():
+    from harmony_tpu.metrics import Registry
+
+    text = Registry().expose()
+    assert "harmony_p2p_invalid_messages_total" in text
+    assert "harmony_p2p_peer_score" in text
+    assert 'harmony_slash_events_total{stage="applied"}' in text
+    assert "harmony_slash_amount_atto_total" in text
+
+
+def test_wire_spray_variants_never_crash_honest_validators():
+    """Every spray variant lands on a real node's gossip validators:
+    all must be REJECTed (scored) without crashing the host."""
+    nodes, _, (ext, net) = _localnet(2, byz_index=1,
+                                     behaviors=("wire_spray",))
+    byz = nodes[1]
+    import random
+
+    rng = random.Random(99)
+    for _ in range(200):
+        byz._spray_once(rng)
+    assert byz.byz_actions["wire_spray"] > 0
+    assert net.invalid_total > 0
+    # the honest node's pump survives whatever was delivered pre-mute
+    nodes[0].process_pending()
+    assert nodes[0].chain.head_number == 0

@@ -142,6 +142,8 @@ def test_scenario_registry_names_and_shape():
         "view_change_storm", "epoch_election_rotation",
         "cross_shard_partition", "validator_churn", "sidecar_flap",
         "leader_kill_restart", "rolling_restart",
+        "byz_equivocating_leader", "byz_double_voter_slashed",
+        "byz_invalid_proposal_flood",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):

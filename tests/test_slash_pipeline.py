@@ -1,0 +1,363 @@
+"""End-to-end double-sign slashing pipeline (ISSUE 13): record codec,
+verification edge cases, economic application through the chain, node
+detection -> gossip -> inclusion, and the bounded evidence queues."""
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.chaostest import fixtures as FX
+from harmony_tpu.consensus.signature import (
+    construct_commit_payload,
+    prepare_payload,
+)
+from harmony_tpu.core.blockchain import Blockchain, ChainError
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.node.worker import Worker
+from harmony_tpu.staking import slash as SL
+
+CHAIN_ID = 2
+
+
+def _record(key, height=100, view=7, epoch=3, shard=0,
+            offender=b"\x0f" * 20, reporter=b"\x1e" * 20,
+            h1=bytes([1]) * 32, h2=bytes([2]) * 32,
+            second_payload=None):
+    votes = []
+    for i, h in enumerate((h1, h2)):
+        payload = construct_commit_payload(h, height, view)
+        if i == 1 and second_payload is not None:
+            payload = second_payload
+        votes.append(SL.Vote(
+            signer_pubkeys=[key.pub.bytes],
+            block_header_hash=h,
+            signature=key.sign_hash(payload).bytes,
+        ))
+    return SL.Record(
+        evidence=SL.Evidence(
+            moment=SL.Moment(epoch, shard, height, view),
+            first_vote=votes[0], second_vote=votes[1],
+            offender=offender,
+        ),
+        reporter=reporter,
+    )
+
+
+@pytest.fixture(scope="module")
+def key():
+    return B.PrivateKey.generate(b"\x77")
+
+
+# -- codec -------------------------------------------------------------------
+
+
+def test_record_codec_roundtrip(key):
+    rec = _record(key)
+    blob = SL.encode_record(rec)
+    back = SL.decode_record(blob)
+    assert back == rec
+    many = SL.encode_records([rec, _record(key, height=101)])
+    assert SL.decode_records(many) == [rec, _record(key, height=101)]
+
+
+def test_record_fingerprint_ignores_reporter(key):
+    a = _record(key, reporter=b"\x1e" * 20)
+    b = _record(key, reporter=b"\x2f" * 20)
+    assert SL.record_fingerprint(a) == SL.record_fingerprint(b)
+    c = _record(key, height=101)
+    assert SL.record_fingerprint(a) != SL.record_fingerprint(c)
+
+
+def test_decode_rejects_inflated_key_count(key):
+    """A forged vote key count must be rejected BEFORE allocation."""
+    import struct
+
+    blob = bytearray(SL.encode_record(_record(key)))
+    # the first vote's u16 key count sits right after the 28B moment
+    struct.pack_into("<H", blob, 28, 0xFFFF)
+    with pytest.raises(ValueError, match="implausible"):
+        SL.decode_record(bytes(blob))
+
+
+def test_decode_rejects_truncation_and_trailing(key):
+    blob = SL.encode_record(_record(key))
+    for cut in (1, 10, 27, 30, len(blob) - 1):
+        with pytest.raises(ValueError):
+            SL.decode_record(blob[:cut])
+    with pytest.raises(ValueError, match="trailing"):
+        SL.decode_record(blob + b"\x00")
+
+
+def test_decode_records_caps_count(key):
+    import struct
+
+    blob = struct.pack("<H", SL.MAX_SLASHES_PER_BLOCK + 1)
+    with pytest.raises(ValueError, match="cap"):
+        SL.decode_records(blob + b"\x00" * 64)
+
+
+# -- verification edge cases (satellite: distinct errors) --------------------
+
+
+def test_verify_rejects_non_committee_signer(key):
+    other = B.PrivateKey.generate(b"\x78")
+    with pytest.raises(SL.SlashVerifyError,
+                       match="not in committee"):
+        SL.verify_record(_record(key), [other.pub.bytes])
+
+
+def test_verify_rejects_same_hash_votes(key):
+    rec = _record(key, h1=bytes([3]) * 32, h2=bytes([3]) * 32)
+    with pytest.raises(SL.SlashVerifyError, match="do not conflict"):
+        SL.verify_record(rec, [key.pub.bytes])
+
+
+def test_verify_rejects_invalid_ballot_signature(key):
+    rec = _record(key)
+    rec.evidence.second_vote.signature = bytes(96)
+    with pytest.raises(SL.SlashVerifyError,
+                       match="signature invalid"):
+        SL.verify_record(rec, [key.pub.bytes])
+
+
+def test_verify_rejects_wrong_phase_payload(key):
+    """A ballot signed over the PREPARE payload (bare hash) is its own
+    distinct rejection — only commit ballots are slashable."""
+    h2 = bytes([2]) * 32
+    rec = _record(key)
+    rec.evidence.second_vote.signature = key.sign_hash(
+        prepare_payload(h2)
+    ).bytes
+    with pytest.raises(SL.SlashVerifyError, match="wrong phase"):
+        SL.verify_record(rec, [key.pub.bytes])
+
+
+def test_verify_rejects_self_report(key):
+    rec = _record(key, offender=b"\x1e" * 20, reporter=b"\x1e" * 20)
+    with pytest.raises(SL.SlashVerifyError, match="same"):
+        SL.verify_record(rec, [key.pub.bytes])
+
+
+def test_verify_rejects_disjoint_keys(key):
+    other = B.PrivateKey.generate(b"\x79")
+    rec = _record(key)
+    rec.evidence.second_vote = _record(other).evidence.second_vote
+    with pytest.raises(SL.SlashVerifyError, match="no matching"):
+        SL.verify_record(rec, [key.pub.bytes, other.pub.bytes])
+
+
+# -- chain application -------------------------------------------------------
+
+
+@pytest.fixture()
+def staked_chain():
+    """A staking chain past its first election with one staked
+    external validator seated in the epoch-1 committee."""
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_accounts=5, n_keys=5)
+    fin = FX.staking_finalizer(genesis, ecdsa_keys)
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=4,
+                       finalizer=fin)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    ext = FX.external_bls_key(99)
+    pool.add(FX.external_validator_stake(ecdsa_keys[0], ext,
+                                         chain_id=CHAIN_ID),
+             is_staking=True)
+    FX.advance_with_full_bitmaps(chain, pool, 4)
+    assert ext.pub.bytes in chain.committee_for_epoch(1)
+    return chain, pool, ecdsa_keys, ext
+
+
+def _staked_record(chain, ecdsa_keys, ext, height=4, view=9):
+    return _record(
+        ext, height=height, view=view, epoch=chain.epoch_of(height),
+        offender=ecdsa_keys[0].address(),
+        reporter=ecdsa_keys[1].address(),
+    )
+
+
+def test_slash_applied_through_block(staked_chain):
+    """Propose-with-record -> header.slashes sealed -> insert replays
+    the verification + application: offender slashed at the reference
+    rate and banned, reporter rewarded half, next election excludes."""
+    chain, pool, ecdsa_keys, ext = staked_chain
+    rec = _staked_record(chain, ecdsa_keys, ext)
+    offender, reporter = rec.evidence.offender, rec.reporter
+    stake0 = chain.state().validator(offender).total_delegation()
+    rep0 = chain.state().balance(reporter)
+
+    worker = Worker(chain, pool)
+    block = worker.propose_block(view_id=chain.head_number + 1,
+                                 slashes=[rec])
+    assert block.header.slashes
+    assert SL.decode_records(block.header.slashes) == [rec]
+    assert chain.insert_chain([block], verify_seals=False) == 1
+
+    w = chain.state().validator(offender)
+    expect = SL.apply_slash(stake0)
+    assert w.status == 2  # banned
+    assert stake0 - w.total_delegation() == expect.total_slashed
+    assert chain.state().balance(reporter) - rep0 == (
+        expect.total_beneficiary_reward
+    )
+    # the election AFTER the ban must drop the offender's key
+    FX.advance_with_full_bitmaps(chain, pool, 8 - chain.head_number)
+    assert ext.pub.bytes not in chain.committee_for_epoch(2)
+
+
+def test_duplicate_slash_rejected_and_proposer_drops_it(staked_chain):
+    chain, pool, ecdsa_keys, ext = staked_chain
+    rec = _staked_record(chain, ecdsa_keys, ext)
+    worker = Worker(chain, pool)
+    b1 = worker.propose_block(view_id=chain.head_number + 1,
+                              slashes=[rec])
+    assert chain.insert_chain([b1], verify_seals=False) == 1
+    # the proposer dry-applies and silently DROPS the consumed record
+    b2 = worker.propose_block(view_id=chain.head_number + 1,
+                              slashes=[rec])
+    assert b2.header.slashes == b""
+    # a forged header carrying it anyway is rejected on insert
+    b2.header.slashes = SL.encode_records([rec])
+    with pytest.raises(ChainError, match="already banned"):
+        chain.insert_chain([b2], verify_seals=False)
+
+
+def test_forged_slash_payload_rejects_block(staked_chain):
+    chain, pool, ecdsa_keys, ext = staked_chain
+    worker = Worker(chain, pool)
+    block = worker.propose_block(view_id=chain.head_number + 1)
+    block.header.slashes = b"\xff" * 40  # undecodable
+    with pytest.raises(ChainError, match="bad slash payload"):
+        chain.insert_chain([block], verify_seals=False)
+    # structurally valid but cryptographically bogus record
+    bogus = _staked_record(chain, ecdsa_keys, ext)
+    bogus.evidence.second_vote.signature = bytes(96)
+    block2 = worker.propose_block(view_id=chain.head_number + 1)
+    block2.header.slashes = SL.encode_records([bogus])
+    with pytest.raises(ChainError, match="invalid slash record"):
+        chain.insert_chain([block2], verify_seals=False)
+
+
+def test_future_evidence_rejected(staked_chain):
+    chain, pool, ecdsa_keys, ext = staked_chain
+    rec = _staked_record(chain, ecdsa_keys, ext,
+                         height=chain.head_number + 5)
+    with pytest.raises(ChainError, match="future"):
+        chain.apply_slash_records(
+            chain.state().copy(), [rec], chain.head_number + 1
+        )
+
+
+# -- node detection / gossip / queue ----------------------------------------
+
+
+def _leader_node(bls_keys, finalizer_keys=None):
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+
+    genesis, ecdsa_keys, keys = dev_genesis(n_keys=4)
+    net = InProcessNetwork()
+    fin = FX.staking_finalizer(genesis, ecdsa_keys)
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16,
+                       finalizer=fin)
+    pool = TxPool(CHAIN_ID, 0, chain.state)
+    reg = Registry(blockchain=chain, txpool=pool,
+                   host=net.host("leader"))
+    node = Node(reg, PrivateKeys.from_keys([keys[1]]))
+    return node, net, keys, ecdsa_keys
+
+
+def _double_commit(node, rogue, fake_hash=None):
+    """Feed the leader a legit commit vote then a conflicting one."""
+    from harmony_tpu.consensus.messages import FBFTMessage, MsgType
+
+    announced = node.leader.current_block_hash
+    legit_payload = node.leader._commit_payload(announced)
+    node._on_commit(FBFTMessage(
+        msg_type=MsgType.COMMIT, view_id=node.view_id,
+        block_num=node.block_num, block_hash=announced,
+        sender_pubkeys=[rogue.pub.bytes],
+        payload=rogue.sign_hash(legit_payload).bytes,
+    ))
+    fake = fake_hash or bytes([0xAB]) * 32
+    node._on_commit(FBFTMessage(
+        msg_type=MsgType.COMMIT, view_id=node.view_id,
+        block_num=node.block_num, block_hash=fake,
+        sender_pubkeys=[rogue.pub.bytes],
+        payload=rogue.sign_hash(
+            node.leader._commit_payload(fake)
+        ).bytes,
+    ))
+
+
+def test_commit_conflict_builds_record_and_gossips():
+    """A commit-phase double vote at the leader becomes a verifiable
+    Record, is queued for proposal, and floods the slash topic."""
+    node, net, keys, ecdsa_keys = _leader_node(None)
+    assert node.is_leader
+    node.start_round_if_leader()
+
+    heard = []
+    probe = net.host("probe")
+    probe.subscribe(node._slash_topic, lambda t, p, f: heard.append(p))
+
+    _double_commit(node, keys[2])
+    assert node.double_sign_events == 1
+    assert len(node.pending_slash_records) == 1
+    rec = node.pending_slash_records[0]
+    SL.verify_record(rec, node.committee())  # re-verifies clean
+    # offender resolved via the finalizer's harmony account table
+    assert rec.evidence.offender == ecdsa_keys[2].address()
+    assert rec.reporter == ecdsa_keys[1].address()
+    assert heard, "record was not published on the slash topic"
+    # includable only when the offender has slashable on-chain stake
+    assert node._includable_slashes() == []
+
+
+def test_gossiped_record_queued_with_dedup():
+    from harmony_tpu.node.ingress import (
+        NODE_MSG_SLASH, MessageCategory, pack_envelope,
+    )
+
+    node, net, keys, ecdsa_keys = _leader_node(None)
+    rogue = keys[2]
+    rec = _record(
+        rogue, height=node.block_num - 0, view=node.view_id,
+        epoch=0, offender=ecdsa_keys[2].address(),
+        reporter=ecdsa_keys[3].address(),
+    )
+    # moment height must be in the past for chain-side checks, but the
+    # node-side gossip handler only verifies the evidence crypto
+    env = pack_envelope(MessageCategory.NODE, NODE_MSG_SLASH,
+                        SL.encode_record(rec))
+    node._handle(env)
+    assert len(node.pending_slash_records) == 1
+    node._handle(env)  # duplicate: deduped by fingerprint
+    assert len(node.pending_slash_records) == 1
+    # garbage on the slash topic is rejected by the validator
+    from harmony_tpu.p2p.host import REJECT
+
+    assert node._slash_validator(b"\x01\x10garbage", "x") == REJECT
+
+
+def test_forensic_queue_evicts_duplicates_then_counts_drops():
+    node, net, keys, _ = _leader_node(None)
+    mk = lambda i: {  # noqa: E731
+        "height": i, "view_id": i, "keys": [f"{i:02x}"],
+        "shard_id": 0, "first_hash": "", "first_keys": [],
+        "first_signature": "", "second_hash": "",
+        "second_signature": "",
+    }
+    for i in range(64):
+        node._queue_forensic_evidence(mk(i))
+    assert len(node.pending_double_signs) == 64
+    # a duplicate of an existing entry evicts the old copy, no drop
+    node._queue_forensic_evidence(mk(3))
+    assert len(node.pending_double_signs) == 64
+    assert node.double_signs_dropped == 0
+    # a FRESH offender at the cap is dropped — logged once + counted
+    node._queue_forensic_evidence(mk(99))
+    assert node.double_signs_dropped == 1
+    assert node._ds_drop_logged

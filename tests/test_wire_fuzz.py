@@ -1,0 +1,216 @@
+"""Seed-deterministic structured fuzz for the wire decoders (ISSUE 13):
+mutated / truncated / length-inflated inputs must raise TYPED errors
+(ValueError family), never crash with an untyped exception, hang, or
+allocate unbounded buffers.  Every case derives from random.Random(seed)
+so a failure reproduces exactly."""
+
+import random
+import socket
+import struct
+import time
+
+import pytest
+
+from harmony_tpu import bls as B
+from harmony_tpu.consensus.messages import (
+    FBFTMessage,
+    MsgType,
+    decode_message,
+    encode_message,
+    sign_message,
+)
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.sidecar import protocol as SP
+from harmony_tpu.staking import slash as SL
+
+SEED = 0xF0221
+N_MUTATIONS = 300
+
+# the decode contract: these (all ValueError subclasses included) are
+# the ONLY acceptable rejections — anything else is a crash
+TYPED = (ValueError, IndexError, KeyError)
+
+
+def _mutations(rng, base: bytes):
+    """Classic structured mutations: byte flips, truncations, random
+    splices, and length-field inflation at random offsets."""
+    for _ in range(N_MUTATIONS):
+        kind = rng.randrange(4)
+        buf = bytearray(base)
+        if kind == 0 and buf:  # flip a few bytes
+            for _ in range(rng.randrange(1, 4)):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        elif kind == 1:  # truncate
+            buf = buf[:rng.randrange(len(buf) + 1)]
+        elif kind == 2 and len(buf) >= 4:  # inflate a 4-byte field
+            struct.pack_into(
+                "<I", buf, rng.randrange(len(buf) - 3),
+                rng.choice([0xFFFFFFFF, 2**31, len(buf) * 1000]),
+            )
+        else:  # random splice
+            at = rng.randrange(len(buf) + 1)
+            buf[at:at] = rng.randbytes(rng.randrange(1, 32))
+        yield bytes(buf)
+
+
+def _fuzz(decoder, base: bytes, budget_s: float = 20.0):
+    rng = random.Random(SEED)
+    t0 = time.monotonic()
+    for mutant in _mutations(rng, base):
+        try:
+            decoder(mutant)
+        except TYPED:
+            pass  # the contract: typed rejection
+        # any OTHER exception propagates and fails the test
+    took = time.monotonic() - t0
+    assert took < budget_s, (
+        f"{N_MUTATIONS} mutants took {took:.1f}s — some decode path "
+        "is not bounded"
+    )
+
+
+def test_fuzz_consensus_message_decoder():
+    keys = PrivateKeys.from_keys(
+        [B.PrivateKey.generate(bytes([i])) for i in (1, 2)]
+    )
+    msg = sign_message(FBFTMessage(
+        msg_type=MsgType.PREPARED, view_id=7, block_num=42,
+        block_hash=bytes(range(32)), sender_pubkeys=[
+            k.pub.bytes for k in keys
+        ],
+        payload=b"\x05" * 97, block=b"\x06" * 200,
+        trace_ctx=b"\x07" * 26,
+    ), keys)
+    _fuzz(decode_message, encode_message(msg))
+
+
+def test_consensus_message_length_inflation_rejected_fast():
+    """The worst case explicitly: a tiny frame claiming 2^31-sized
+    fields must be rejected in microseconds, before any allocation."""
+    base = bytearray(encode_message(FBFTMessage(
+        msg_type=MsgType.COMMIT, view_id=1, block_num=1,
+        block_hash=bytes(32), sender_pubkeys=[], payload=b"x" * 8,
+    )))
+    # payload length prefix sits after type+view+block+hash+keycount
+    struct.pack_into("<I", base, 1 + 8 + 8 + 32 + 4, 2**31)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        decode_message(bytes(base))
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_fuzz_sidecar_parsers():
+    committee = SP.build_set_committee(3, 0, [b"\x01" * 48] * 4)
+    agg = SP.build_agg_verify(3, 0, b"payload", b"\x0f", b"\x02" * 96)
+    batch = SP.build_verify_batch(
+        [(b"\x01" * 48, b"p%d" % i, b"\x02" * 96) for i in range(3)]
+    )
+    _fuzz(SP.parse_set_committee, committee)
+    _fuzz(SP.parse_agg_verify, agg)
+    _fuzz(SP.parse_verify_batch, batch)
+
+
+def test_sidecar_batch_count_inflation_rejected_before_allocation():
+    buf = bytearray(SP.build_verify_batch(
+        [(b"\x01" * 48, b"p", b"\x02" * 96)]
+    ))
+    struct.pack_into("<I", buf, 0, 0xFFFFFFF0)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="implausible"):
+        SP.parse_verify_batch(bytes(buf))
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_fuzz_slash_record_decoder():
+    key = B.PrivateKey.generate(b"\x55")
+    payload = b"\x01" * 96
+    vote = SL.Vote([key.pub.bytes], bytes([1]) * 32, payload)
+    vote2 = SL.Vote([key.pub.bytes], bytes([2]) * 32, payload)
+    rec = SL.Record(
+        evidence=SL.Evidence(
+            moment=SL.Moment(1, 0, 9, 9), first_vote=vote,
+            second_vote=vote2, offender=b"\x0f" * 20,
+        ),
+        reporter=b"\x1e" * 20,
+    )
+    _fuzz(SL.decode_record, SL.encode_record(rec))
+    _fuzz(SL.decode_records, SL.encode_records([rec]))
+
+
+def test_fuzz_block_decoder():
+    from harmony_tpu.chain.header import Header
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.types import Block, Transaction
+
+    tx = Transaction(nonce=0, gas_price=1, gas_limit=21000, shard_id=0,
+                     to_shard=0, to=b"\x2d" * 20, value=5,
+                     sig=b"\x01" * 65)
+    block = Block(Header(shard_id=0, block_num=3), [tx], [], [], [0])
+    _fuzz(rawdb.decode_block, rawdb.encode_block(block, 2))
+
+
+def test_fuzz_viewchange_decoders():
+    from harmony_tpu.consensus import view_change as VC
+
+    vc = VC.ViewChangeMsg(
+        view_id=9, block_num=4, sender_pubkeys=[b"\x01" * 48],
+        m3_sig=b"\x02" * 96, m2_sig=b"\x03" * 96, m1_sig=b"",
+        m1_payload=b"\x04" * 40,
+    )
+    _fuzz(VC.decode_viewchange, VC.encode_viewchange(vc))
+
+
+def test_sync_server_survives_garbage_frames():
+    """Raw garbage at a SyncServer: oversized length prefixes and junk
+    frames drop the CONNECTION, never the server — an honest client
+    still gets served afterwards."""
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+
+    genesis, _, _ = dev_genesis(n_keys=4)
+    chain = Blockchain(MemKV(), genesis)
+    server = SyncServer(chain)
+    try:
+        rng = random.Random(SEED)
+        for _ in range(20):
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            try:
+                kind = rng.randrange(3)
+                if kind == 0:  # absurd frame length
+                    s.sendall(struct.pack("<IBQ", 0x7FFFFFFF, 1, 1))
+                elif kind == 1:  # random junk
+                    s.sendall(rng.randbytes(rng.randrange(1, 64)))
+                else:  # well-framed junk body
+                    body = rng.randbytes(rng.randrange(1, 32))
+                    s.sendall(
+                        struct.pack("<IBQ", len(body), 1, 7) + body
+                    )
+                s.settimeout(2.0)
+                try:
+                    s.recv(64)  # server may answer junk or just close
+                except OSError:
+                    pass
+            finally:
+                s.close()
+        # the server is still alive for honest clients
+        client = SyncClient(server.port, timeout=5.0)
+        head, head_hash = client.get_head()
+        assert head == 0 and len(head_hash) == 32
+        client.close()
+    finally:
+        server.close()
+
+
+def test_sync_client_rejects_forged_response_counts():
+    """A malicious sync peer forging a huge element count in a
+    response body must get a typed rejection, not a 4-billion-iteration
+    decode loop."""
+    from harmony_tpu.p2p import stream as ST
+
+    forged = (0xFFFFFFFE).to_bytes(4, "little") + b"\x00" * 16
+    r = ST._Reader(forged)
+    with pytest.raises(ValueError, match="implausible"):
+        ST._checked_count(r)

@@ -60,6 +60,13 @@
 #      restarts of all validators) via chaos_sweep on durable
 #      topologies; crash_* and restart_recovery_seconds_p99 land as
 #      an ephemeral BENCH round gated by bench_ledger --check.
+#   9. byzantine sweep — the ACTIVE-adversary tier (ISSUE 13): the
+#      slashing-pipeline / wire-fuzz / byzantine-behavior unit
+#      tiers, then the three byz_* scenarios (equivocating leader at
+#      the quorum edge, commit-phase double voter slashed end to
+#      end, invalid-proposal + malformed-wire sprayer throttled and
+#      muted) via chaos_sweep --quick --check; byz_* metrics land as
+#      an ephemeral BENCH round gated by bench_ledger --check.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -109,7 +116,8 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   tests/test_chaostest.py
 CHAOS_ROUND="$(mktemp)"
 CRASH_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND"' EXIT
+BYZ_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --scenario view_change_storm --scenario epoch_election_rotation \
   --scenario cross_shard_partition --scenario validator_churn \
@@ -131,5 +139,19 @@ JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --bench-round 998 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$CRASH_ROUND" > /dev/null
+
+echo "== byzantine sweep: active adversaries + slashing pipeline =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_slash_pipeline.py \
+  tests/test_wire_fuzz.py \
+  tests/test_byzantine.py
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario byz_equivocating_leader \
+  --scenario byz_double_voter_slashed \
+  --scenario byz_invalid_proposal_flood \
+  --bench-out "$BYZ_ROUND" --bench-round 997 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$BYZ_ROUND" > /dev/null
 
 echo "check.sh: OK"
