@@ -5,6 +5,37 @@ election-under-load scenario exercise the SAME wiring)."""
 
 from __future__ import annotations
 
+import time
+
+
+def paced_ticks(rate: float, stop, duration_s: float | None = None,
+                ready=None):
+    """Yield 0, 1, 2, ... paced at ``rate``/s until ``stop`` is set or
+    ``duration_s`` elapses (None = unbounded — the caller bounds the
+    iteration, e.g. by zipping a finite fixture).  ``ready`` (optional
+    Event) gates the start; the pace clock begins after it opens.
+
+    The ONE pacing loop every flood in the chaos runner, soak harness
+    and their kin share — four hand-rolled copies of the
+    sleep-to-target skeleton had already started to drift."""
+    if ready is not None:
+        ready.wait()
+    start = time.monotonic()
+    n = 0
+    while not stop.is_set():
+        now = time.monotonic()
+        if duration_s is not None and now - start >= duration_s:
+            return
+        target = start + n / rate
+        if now < target:
+            # sleep in short chunks (stop-responsive) and re-check the
+            # clock before yielding — a single capped sleep floors the
+            # effective rate at ~1/chunk for slow tickers
+            time.sleep(min(target - now, 0.05))
+            continue
+        yield n
+        n += 1
+
 
 def staking_finalizer(genesis, ecdsa_keys, *, shard_count: int = 1,
                       external_slots: int = 2):
@@ -93,6 +124,28 @@ def plain_transfers(count: int, tag: int):
             out.append((Transaction(
                 nonce=n, gas_price=1, gas_limit=21_000, shard_id=0,
                 to_shard=0, to=b"\x2d" * 20, value=1,
+            ), sender))
+    return out
+
+
+def overload_transfers(ecdsa_keys, *, depth: int = 80,
+                       to_byte: int = 0x2e):
+    """Funded-sender transfers, ``depth`` nonces deep per sender — the
+    cycling overload/steady-state flood fixture (ISSUE 14: shared by
+    the overload_storm scenario and tools/soak.py so the two harnesses
+    cannot silently diverge in the load they generate).  Depth must
+    exceed the per-sender executable tier so a cycling flood can
+    genuinely fill a pool's queue slots."""
+    from ..core.types import Transaction
+
+    out = []
+    for key in ecdsa_keys:
+        sender = key.address()
+        for nonce in range(depth):
+            out.append((Transaction(
+                nonce=nonce, gas_price=1, gas_limit=21_000,
+                shard_id=0, to_shard=0, to=bytes([to_byte]) * 20,
+                value=1,
             ), sender))
     return out
 

@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 
 from .. import faultinject as FI
+from .. import health as HL
 from .. import trace
 from ..log import get_logger
 from .scenario import Scenario
@@ -147,8 +148,14 @@ def _build(scenario: Scenario, registry, built: list | None = None
     top = scenario.topology
     spans = [2 if i < top.multikey else 1 for i in range(top.nodes)]
     n_keys = sum(spans)
+    # the overload flood needs enough FUNDED senders to genuinely fill
+    # a pool (per-sender slots bound what one account can hold): widen
+    # the dev alloc, committee unchanged
+    n_accounts = n_keys
+    if scenario.traffic.node_pool_rate > 0:
+        n_accounts = max(n_keys, 64)
     genesis0, ecdsa_keys, bls_keys = dev_genesis(
-        n_accounts=n_keys, n_keys=n_keys, shard_id=0
+        n_accounts=n_accounts, n_keys=n_keys, shard_id=0
     )
     shard_genesis = {0: genesis0}
     for s in range(1, top.shards):
@@ -193,18 +200,21 @@ def _build(scenario: Scenario, registry, built: list | None = None
                 ctx_cache[key] = ctx
             return ctx
 
-    def mk_chain(shard: int, data_path: str | None = None):
+    def mk_chain(shard: int, data_path: str | None = None,
+                 label: str = "replica"):
         """A full chain for ``shard``: trustless committee provider
         (each chain answers epochs from ITS OWN persisted elections),
         optional finalizer, optional sidecar-backed engine.
         ``data_path`` makes it durable (FileKV — reopening the same
-        path runs recovery-on-open).  Returns
+        path runs recovery-on-open).  ``label`` names the sidecar
+        client's watchdog participant.  Returns
         (chain, sidecar_client_or_None)."""
         client = None
         if env.sidecar_server is not None:
             from ..sidecar.client import SidecarClient
 
-            client = SidecarClient(env.sidecar_server.address)
+            client = SidecarClient(env.sidecar_server.address,
+                                   label=label)
         holder: dict = {}
 
         def provider(s, epoch):
@@ -235,7 +245,7 @@ def _build(scenario: Scenario, registry, built: list | None = None
         the kill/restart path — a restarted node goes through exactly
         the wiring a fresh one does, on the same data dir."""
         handle.chain, handle.sidecar_client = mk_chain(
-            handle.shard, handle.data_path
+            handle.shard, handle.data_path, label=handle.name
         )
         handle.pool = TxPool(CHAIN_ID, handle.shard, handle.chain.state)
         handle.host = env.net.host(handle.name)
@@ -320,6 +330,13 @@ def _build(scenario: Scenario, registry, built: list | None = None
     for h in env.handles:
         wire_sync(h)
 
+    # resource baseline for the overload invariants: what the process
+    # held BEFORE any traffic — the bounded-resources check diffs the
+    # post-run sample against this
+    from ..metrics import process_sample
+
+    env.data["res_t0"] = process_sample()
+
     # staking topologies: register the external validators up front so
     # epoch 0's election block seats them (POPs verify on the INGRESS
     # lane like any live registration)
@@ -350,18 +367,14 @@ def _paced_flood(env: RunEnv, txs, rate: float, is_staking: bool,
         def balance(self, addr):
             return 10**30
 
+    from . import fixtures as FX
+
     try:
         pool = TxPool(CHAIN_ID, 0, _StubState, cap=len(txs) + 64)
         ready.wait()
         start = time.monotonic()
         n = 0
-        for i, (tx, sender) in enumerate(txs):
-            if stop.is_set():
-                break
-            target = start + i / rate
-            now = time.monotonic()
-            if now < target:
-                time.sleep(min(target - now, 0.05))
+        for _, (tx, sender) in zip(FX.paced_ticks(rate, stop), txs):
             try:
                 pool.add(tx, is_staking=is_staking, sender=sender)
             except PoolError:
@@ -371,6 +384,40 @@ def _paced_flood(env: RunEnv, txs, rate: float, is_staking: bool,
     except Exception as e:  # noqa: BLE001 — fail the scenario loudly
         env.errors.append(f"{category} flood: {e!r}")
         done.append((category, 0, 0.0))
+
+
+def _node_pool_flood(env: RunEnv, txs, rate: float, duration_s: float,
+                     ready, stop, done: list):
+    """Overload flood (ISSUE 14): paced submission ATTEMPTS into the
+    real shard-0 node pools, round-robin, cycling a bounded fixture
+    for the whole window.  At 10x rated most attempts are REJECTED
+    (overload floor, caps, same-nonce replacement) — which is the
+    scenario's premise: the node must refuse work cheaply and keep
+    committing, not wedge or balloon.  Pool/admission errors are the
+    expected outcome; only unexpected exceptions fail the scenario."""
+    from ..core.tx_pool import PoolError
+    from . import fixtures as FX
+
+    try:
+        ready.wait()
+        pools = [h.pool for h in env.by_shard(0)]
+        start = time.monotonic()
+        n = 0
+        for i in FX.paced_ticks(rate, stop, duration_s):
+            tx, sender = txs[i % len(txs)]
+            # every node sees every submission (the gossip-admission
+            # shape): overload pressure is per-NODE, not per-network
+            for pool in pools:
+                try:
+                    pool.add(tx, sender=sender)
+                except PoolError:
+                    pass  # refused = governed; the invariant counts it
+            n += 1
+        done.append(("node_pool", n, time.monotonic() - start))
+        env.data["node_pool_submitted"] = n
+    except Exception as e:  # noqa: BLE001 — fail the scenario loudly
+        env.errors.append(f"node_pool flood: {e!r}")
+        done.append(("node_pool", 0, 0.0))
 
 
 def _replay_worker(env: RunEnv, stop):
@@ -649,7 +696,8 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                         kills.append(task)
                 end = (None if phase.duration_s is None
                        else time.monotonic() + phase.duration_s)
-                active.append((phase, end, names))
+                cap = time.monotonic() + phase.hold_max_s
+                active.append((phase, end, names, cap))
                 _log.warn(
                     "chaos phase armed", phase=phase.name,
                     at_round=head, t_s=round(now_s, 2),
@@ -657,12 +705,24 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                     arms=len(phase.arms), kills=len(phase.kills),
                 )
             for entry in active[:]:
-                phase, end, names = entry
-                if end is not None and time.monotonic() >= end:
-                    for nm in names:
-                        env.net.partitioned.discard(nm)
-                    active.remove(entry)
-                    _log.warn("chaos phase healed", phase=phase.name)
+                phase, end, names, cap = entry
+                if end is None or time.monotonic() < end:
+                    continue
+                # load-relative close: past the nominal window, hold
+                # the fault open until its job is provably done (or
+                # the hard cap trips and the invariant judges it)
+                if (phase.hold_until is not None
+                        and time.monotonic() < cap):
+                    try:
+                        done = bool(phase.hold_until(env))
+                    except Exception:
+                        done = True  # a broken predicate must not wedge
+                    if not done:
+                        continue
+                for nm in names:
+                    env.net.partitioned.discard(nm)
+                active.remove(entry)
+                _log.warn("chaos phase healed", phase=phase.name)
             for task in kills:
                 h, kill = task["h"], task["kill"]
                 if task["state"] == "armed":
@@ -707,7 +767,7 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
         # (armed rules expire by their own t1 windows); a node still
         # DOWN with a pending restart is restarted so teardown and
         # invariants see the recovered shape, not a half-run script
-        for _, _, names in active:
+        for _, _, names, _ in active:
             for nm in names:
                 env.net.partitioned.discard(nm)
         for task in kills:
@@ -845,6 +905,16 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
 
     FI.reset()
     FI.set_seed(scenario.seed)
+    # fresh watchdog state per scenario: counters zeroed (invariants
+    # read them after teardown), detection thresholds per topology
+    HL.reset()
+    if scenario.topology.watchdog_max_age_s is not None:
+        HL.configure(
+            default_max_age_s=scenario.topology.watchdog_max_age_s,
+            check_interval_s=min(
+                0.25, scenario.topology.watchdog_max_age_s / 2
+            ),
+        )
     sched.reset()
     sched.configure(flush_window_s=0.01)
     trace.reset()
@@ -868,26 +938,56 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
     threads: list = []
     pumps: list = []
     t0 = time.monotonic()
+    gov = None
     try:
         env = _build(scenario, registry, built)
         tr = scenario.traffic
+        if scenario.topology.governor:
+            # a process-wide governor with CI-window limits: the pools
+            # can actually fill inside the window, so the tier machine
+            # (and every knob behind it) genuinely engages
+            from .. import governor as GV
+
+            gov = GV.ResourceGovernor(
+                limits=GV.Limits(
+                    queue_pressured=192, queue_critical=512,
+                    pool_pressured=0.5, pool_critical=0.85,
+                    dwell_s=1.0,
+                ),
+                interval_s=0.25,
+                pressured_ingress_rate=50.0,
+            )
+            for h in env.by_shard(0):
+                gov.attach_pool(h.pool)
+            GV.install(gov)
+            gov.start()
+            env.data["governor"] = gov
+            env.data["gov_rejections_0"] = GV.rejections_total()
+        from . import fixtures as FX
+
         flood_specs = []
         if tr.plain_rate > 0:
             count = int(tr.plain_rate * tr.flood_duration_s)
-            from . import fixtures as FX
-
             flood_specs.append(
                 (FX.plain_transfers(count, 1), tr.plain_rate, False,
                  "plain")
             )
         if tr.pop_rate > 0:
             count = max(4, int(tr.pop_rate * tr.flood_duration_s))
-            from . import fixtures as FX
-
             flood_specs.append(
                 (FX.pop_submissions(count, 2, scenario.seed),
                  tr.pop_rate, True, "pop")
             )
+        n_floods = len(flood_specs)
+        if tr.node_pool_rate > 0:
+            overload_txs = FX.overload_transfers(env.ecdsa_keys)
+            threads.append(threading.Thread(
+                target=_node_pool_flood,
+                args=(env, overload_txs, tr.node_pool_rate,
+                      tr.flood_duration_s, ready, stop, floods_done),
+                daemon=True,
+            ))
+            n_floods += 1
         for spec in flood_specs:
             threads.append(threading.Thread(
                 target=_paced_flood,
@@ -927,7 +1027,6 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         ready.set()
 
         deadline = t0 + scenario.window_s
-        n_floods = len(flood_specs)
 
         def customs_ok() -> bool:
             # scenario-specific goals gate COMPLETION too: a cross-
@@ -996,6 +1095,11 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
                         pass
             if env.sidecar_server is not None:
                 env.sidecar_server.stop()
+        if gov is not None:
+            from .. import governor as GV
+
+            gov.stop()
+            GV.uninstall()
         FI.reset()
         # stop the global scheduler flush thread too: a daemon thread
         # parked in a native wait at interpreter exit is the classic
@@ -1027,6 +1131,15 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         v["dump"] = path
         if path:
             violation_dumps.append(path)
+
+    # the invariants (including customs reading HL.EVENTS /
+    # recovered_names) have all run: stop the watchdog daemon and
+    # restore the process-global defaults NOW, not at the next run() —
+    # a scenario's tightened config (0.25s sweeps, 2.5s max-age) must
+    # not leak spurious stale flags into whatever the host process
+    # does next, and a daemon thread parked in a native wait at
+    # interpreter exit is the same abort vector sched.reset() guards
+    HL.reset()
 
     # durable stores stay OPEN through invariant evaluation (the fork
     # and custom checks read blocks back); release them only now

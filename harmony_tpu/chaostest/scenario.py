@@ -57,6 +57,14 @@ class Topology:
     # / withhold / wire_spray).  Liveness/divergence invariants then
     # judge the HONEST nodes only; the adversary is the fault.
     byzantine: tuple = ()
+    # arm a process-wide resource governor (ISSUE 14): tightened limits
+    # suited to a CI-window localnet, attached to every node's pool —
+    # the overload scenarios assert its tier transitions + rejections
+    governor: bool = False
+    # override the health watchdog's default participant max-age (and
+    # tighten its check interval): the wedged-thread scenario needs
+    # detection inside its fault window
+    watchdog_max_age_s: float | None = None
 
 
 @dataclass(frozen=True)
@@ -68,6 +76,11 @@ class Traffic:
     replay_workers: int = 0    # chain re-verification loops (SYNC)
     cross_shard_transfers: int = 0  # shard-0 -> shard-1 transfers
     flood_duration_s: float = 6.0   # how long the paced floods run
+    # overload flood (ISSUE 14): paced submission ATTEMPTS into the
+    # REAL shard-0 node pools (round-robin), cycling a bounded fixture
+    # — at 10x rated most attempts are rejections (floor / caps /
+    # replacement), which is the point: rejected, counted, not crashed
+    node_pool_rate: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -105,7 +118,15 @@ class Phase:
     black-hole out of the gossip hub for the window: literal host
     names (``"s0n1"``), ``"leader"`` (shard 0's leader at trigger
     time) or ``"leader:<shard>"``; they are healed when the window
-    closes."""
+    closes.
+
+    ``hold_until`` makes the window's close LOAD-RELATIVE (ISSUE 14
+    deflake): a predicate ``fn(env) -> bool`` checked once
+    ``duration_s`` elapses — the window stays open until it returns
+    True (the fault has provably done its job, e.g. a NEWVIEW
+    adopted), capped at ``hold_max_s`` after trigger so a scenario
+    whose fault genuinely never bites still heals and fails its
+    invariant instead of wedging the run."""
 
     name: str
     at_round: int | None = None
@@ -114,6 +135,8 @@ class Phase:
     arms: tuple = ()
     partition: tuple = ()
     kills: tuple = ()  # Kill specs executed at trigger time
+    hold_until: object = None    # fn(env) -> bool, checked after duration_s
+    hold_max_s: float = 30.0     # hard cap on a held window, from trigger
 
 
 @dataclass(frozen=True)

@@ -51,7 +51,22 @@ def _cx_arrived(env):
 def view_change_storm(quick: bool = False) -> Scenario:
     """Leader black-holed mid-round under an ingress flood: the
     committee must view-change to a live leader, keep committing, and
-    the healed ex-leader must resync and rejoin."""
+    the healed ex-leader must resync and rejoin.
+
+    Timing margins are LOAD-TOLERANT by design (ISSUE 14 deflake: the
+    tier-1-resident quick run flaked once under full-suite box load in
+    PR 13): the p99 bound covers a storm round that spans the black-
+    hole window PLUS one escalated VC ladder step on an oversubscribed
+    box, and the window leaves room for the post-heal resync — the
+    SHARP assertions here are min_view_changes and liveness, not the
+    latency of a deliberately wedged round.  The black-hole itself is
+    LOAD-RELATIVE (``hold_until``): on an oversubscribed box the VC
+    ladder (detect -> escalated timeouts -> M3 quorum -> NEWVIEW) can
+    outlast any fixed wall-clock window, and healing early hands the
+    round back to the original leader with ZERO adoptions — so the
+    partition holds until one NEWVIEW has actually been adopted,
+    capped so a genuinely broken VC path still heals and fails the
+    invariant instead of wedging the run."""
     return Scenario(
         name="view_change_storm",
         seed=11,
@@ -69,14 +84,19 @@ def view_change_storm(quick: bool = False) -> Scenario:
                 "blackhole-leader", at_round=2,
                 duration_s=6.0 if quick else 12.0,
                 partition=("round_leader",),
+                hold_until=lambda env: sum(
+                    h.node.new_views_adopted
+                    for h in env.handles if h.node is not None
+                ) >= 1,
+                hold_max_s=45.0 if quick else 60.0,
             ),
         ),
         invariants=Invariants(
             min_blocks=4 if quick else 8,
-            round_p99_s=25.0,
+            round_p99_s=45.0,
             min_view_changes=1,
         ),
-        window_s=90.0 if quick else 180.0,
+        window_s=150.0 if quick else 240.0,
     )
 
 
@@ -631,6 +651,243 @@ def byz_invalid_proposal_flood(quick: bool = False) -> Scenario:
     )
 
 
+# -- overload scenarios (ISSUE 14): past rated capacity ----------------------
+
+
+def _governor_engaged(env):
+    """The governor must have actually tiered up under the 10x flood
+    and refused work: peak tier >= PRESSURED, rejections counted, and
+    any governor-driven scheduler sheds confined to INGRESS/SYNC (the
+    standard zero_consensus_sheds invariant covers the consensus
+    lane)."""
+    from .. import governor as GV
+    from ..sched.scheduler import SHED
+
+    gov = env.data.get("governor")
+    if gov is None:
+        return False, "no governor was armed"
+    if gov.peak < GV.Tier.PRESSURED:
+        return False, (
+            f"governor never left NORMAL (peak {gov.peak.name}) — "
+            "the overload never pressured the node"
+        )
+    rejections = GV.rejections_total() - env.data.get(
+        "gov_rejections_0", 0
+    )
+    if rejections < 1:
+        return False, "the governor never refused a unit of work"
+    submitted = env.data.get("node_pool_submitted", 0)
+    if submitted < 1:
+        return False, "the overload flood never submitted"
+    env.data.setdefault("extra_metrics", {}).update({
+        "overload_peak_tier": _m(int(gov.peak), "tier"),
+        "overload_rejections": _m(int(rejections), "rejections"),
+        "overload_attempts": _m(submitted, "attempts"),
+        "overload_ingress_sheds": _m(
+            SHED.value(lane="ingress", reason="governor"), "sheds",
+        ),
+        "overload_sync_sheds": _m(
+            SHED.value(lane="sync", reason="governor"), "sheds",
+        ),
+    })
+    return True, ""
+
+
+def _resources_bounded(env):
+    """End-of-run process resources must sit inside stationarity
+    bounds relative to the pre-traffic baseline: a 10x overload may
+    cost CPU and latency, never an unbounded RSS / fd / thread climb
+    (the wedge-or-balloon failure modes this scenario exists to
+    catch)."""
+    from ..metrics import process_sample
+
+    t0 = env.data.get("res_t0") or {}
+    t1 = process_sample()
+    bounds = {          # generous for a CI box, fatal for a real leak
+        "rss_bytes": 512 << 20,
+        "open_fds": 64,
+        "threads": 24,
+    }
+    grew = {}
+    for key, bound in bounds.items():
+        a, b = t0.get(key), t1.get(key)
+        if a is None or b is None:
+            continue  # signal unavailable on this platform
+        grew[key] = b - a
+        if b - a > bound:
+            return False, (
+                f"{key} grew {b - a} over the run (bound {bound}) — "
+                "resources are not stationary under overload"
+            )
+    env.data.setdefault("extra_metrics", {}).update({
+        "overload_rss_growth_mib": _m(
+            round(grew.get("rss_bytes", 0) / (1 << 20), 1), "MiB",
+        ),
+        "overload_fd_growth": _m(grew.get("open_fds", 0), "fds"),
+        "overload_thread_growth": _m(grew.get("threads", 0), "threads"),
+    })
+    return True, ""
+
+
+def overload_storm(quick: bool = False) -> Scenario:
+    """10x rated ingress against a governed 4-node localnet: a paced
+    overload flood (cycling funded-sender transfers into every node's
+    REAL pool) plus POP/replay lane pressure.  The governor must tier
+    up (pool fill / queue depth), drive the overload floor + ingress
+    sheds, and the committee must keep committing with ZERO
+    consensus-lane sheds while resources stay inside stationarity
+    bounds — overload degrades ingestion, never liveness."""
+    rated = 300.0 if quick else 1500.0  # the loadgen floor shape
+    return Scenario(
+        name="overload_storm",
+        seed=47,
+        topology=Topology(
+            nodes=4, block_time_s=0.25,
+            phase_timeout_s=6.0 if quick else 9.0,
+            governor=True,
+        ),
+        traffic=Traffic(
+            node_pool_rate=rated * 10,
+            plain_rate=rated,
+            pop_rate=16.0 if quick else 32.0,
+            replay_workers=1,
+            flood_duration_s=8.0 if quick else 16.0,
+        ),
+        # the p99 bound is overload-shaped: rounds compete with the
+        # flood for the box's one vCPU — the SHARP invariants are the
+        # governor customs + zero consensus sheds + liveness
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=60.0,
+            custom=(
+                ("governor_engaged", _governor_engaged),
+                ("resources_bounded", _resources_bounded),
+            ),
+        ),
+        window_s=120.0 if quick else 240.0,
+    )
+
+
+def _watchdog_recovered(env):
+    """The watchdog must have seen BOTH injected faults — the killed
+    flush thread (dead -> supervised restart) and the wedged sidecar
+    reader (stale -> self-recovery) — dumped flight-recorder evidence
+    for each, and the node must have kept committing (the liveness
+    floor covers that part)."""
+    import json as _json
+
+    from .. import health as HL
+    from .. import trace as TR
+
+    ev = HL.EVENTS
+    if ev["dead"] < 1:
+        return False, "the killed flush thread was never detected"
+    if ev["restart"] < 1:
+        return False, "the dead flush thread was never restarted"
+    if ev["stale"] < 1:
+        return False, "the wedged sidecar reader was never detected"
+    # attribution matters: the recovery must belong to a sidecar
+    # READER — an unrelated participant flapping under box load (a
+    # pump flagged stale then closed at teardown) must not satisfy
+    # the injected wedge's recovery
+    if not any(n.startswith("sidecar.reader")
+               for n in HL.recovered_names()):
+        return False, (
+            "no sidecar reader was seen recovering (recovered: "
+            f"{sorted(HL.recovered_names())})"
+        )
+    kinds: dict = {}
+    for path in TR.dumps():
+        try:
+            with open(path) as f:
+                kind = _json.load(f).get("kind", "")
+        except (OSError, ValueError):
+            continue
+        if kind.startswith("watchdog."):
+            kinds[kind] = kinds.get(kind, 0) + 1
+    flush_dumps = kinds.get("watchdog.sched.flush", 0)
+    reader_dumps = sum(
+        n for k, n in kinds.items()
+        if k.startswith("watchdog.sidecar.reader")
+    )
+    # at least the dead-detection dump; a FEW more are tolerated — on
+    # a loaded box a busy flush batch can legitimately trip a stale
+    # flag before the injected kill AND again after the supervised
+    # restart (all real detections, distinct transitions).  The upper
+    # bound is the per-kind cooldown's own machine bound over the run
+    # window: past it, the dedup machinery is broken, not the box busy
+    if not 1 <= flush_dumps <= 4:
+        return False, (
+            f"{flush_dumps} flight-recorder dumps for the flush "
+            "thread (want 1, tolerate up to 4 under box load)"
+        )
+    if reader_dumps < 1:
+        return False, "no flight-recorder dump for the wedged reader"
+    env.data.setdefault("extra_metrics", {}).update({
+        "wedge_dead_detected": _m(ev["dead"], "events"),
+        "wedge_stale_detected": _m(ev["stale"], "events"),
+        "wedge_restarts": _m(ev["restart"], "restarts"),
+        "wedge_recoveries": _m(ev["recovered"], "events"),
+        "wedge_watchdog_dumps": _m(sum(kinds.values()), "dumps"),
+    })
+    return True, ""
+
+
+def wedged_thread_recovery(quick: bool = False) -> Scenario:
+    """Fault-inject the two supervised thread classes mid-round: an
+    unexpected error KILLS the scheduler flush thread (every signature
+    check funnels through it) and a frame-path stall WEDGES a sidecar
+    reader while it is busy.  The health watchdog must detect both
+    inside its max-age window, dump exactly one flight-recorder trace
+    per participant, restart the dead flush thread (restart-safe: its
+    queues live on the scheduler object), let the reader's own
+    redial/deadline machinery recover the wedge — and the committee
+    must keep committing through all of it."""
+    return Scenario(
+        name="wedged_thread_recovery",
+        seed=53,
+        topology=Topology(
+            nodes=4, sidecar=True, block_time_s=0.25,
+            phase_timeout_s=6.0 if quick else 9.0,
+            # tight enough to catch the 4 s reader stall mid-window,
+            # loose enough that a pump busy validating one block on a
+            # loaded box rarely false-positives
+            watchdog_max_age_s=2.5,
+        ),
+        traffic=Traffic(
+            pop_rate=8.0, replay_workers=1,
+            flood_duration_s=5.0 if quick else 10.0,
+        ),
+        phases=(
+            Phase(
+                "wedge-flush-and-reader", at_round=2,
+                duration_s=10.0,
+                arms=(
+                    # one unexpected error at the flush loop's top —
+                    # outside every per-batch catch: the thread DIES
+                    {"point": "sched.flush",
+                     "exc": RuntimeError, "times": 1},
+                    # one long stall on a NODE reader's frame path
+                    # while it is marked busy: a WEDGE, not a death
+                    # (keyed so it cannot land on a short-lived replay
+                    # replica's reader, whose registration a successor
+                    # replica would have replaced already)
+                    {"point": "sidecar.frame", "key": "s0n1",
+                     "delay_s": 4.0, "times": 1},
+                ),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=5 if quick else 9,
+            round_p99_s=60.0,
+            custom=(
+                ("watchdog_recovered", _watchdog_recovered),
+            ),
+        ),
+        window_s=120.0 if quick else 240.0,
+    )
+
+
 SCENARIOS = {
     "view_change_storm": view_change_storm,
     "epoch_election_rotation": epoch_election_rotation,
@@ -642,4 +899,6 @@ SCENARIOS = {
     "byz_equivocating_leader": byz_equivocating_leader,
     "byz_double_voter_slashed": byz_double_voter_slashed,
     "byz_invalid_proposal_flood": byz_invalid_proposal_flood,
+    "overload_storm": overload_storm,
+    "wedged_thread_recovery": wedged_thread_recovery,
 }

@@ -504,6 +504,42 @@ def build_node(cfg: dict):
             node.stop,
         ),
     )
+
+    # overload survival (ISSUE 14): a node-wide resource governor
+    # sampling RSS / fds / threads / scheduler queue depth / pool fill
+    # into the NORMAL->PRESSURED->CRITICAL tiers that drive the
+    # tx-pool floor, RPC 429s, scheduler sheds and the sync window;
+    # /healthz + /readyz on the MetricsServer report its verdicts.
+    # Operator knobs: `governor = false` disarms it, `governor_limits`
+    # (a table of governor.Limits field overrides, e.g.
+    # rss_pressured_bytes) retunes the thresholds for a node whose
+    # healthy steady-state sits above the defaults,
+    # `governor_interval` / `governor_ingress_rate` tune the sampling
+    # cadence and the PRESSURED-tier per-client admission budget
+    if cfg.get("governor", True):
+        from . import governor as GV
+
+        limit_overrides = cfg.get("governor_limits") or {}
+        gov = GV.ResourceGovernor(
+            limits=(GV.Limits(**limit_overrides)
+                    if limit_overrides else None),
+            interval_s=float(cfg.get("governor_interval", 1.0)),
+            pressured_ingress_rate=float(
+                cfg.get("governor_ingress_rate", 100.0)
+            ),
+        )
+        gov.attach_pool(pool)
+
+        def _stop_governor():
+            gov.stop()
+            GV.uninstall()
+
+        manager.register(
+            ServiceType.MAINTENANCE,
+            _CallbackService(
+                lambda: GV.install(gov).start(), _stop_governor,
+            ),
+        )
     return node, manager, reg, rpc, metrics
 
 

@@ -63,6 +63,11 @@ class TxPool:
         self.global_slots = cap if cap is not None else GLOBAL_SLOTS
         self.global_queue = 0 if cap is not None else GLOBAL_QUEUE
         self.price_floor = price_floor
+        # overload knob (ISSUE 14): the resource governor raises this
+        # on PRESSURED/CRITICAL tiers — the effective admission floor
+        # is price_floor * _floor_mult, so cheap spam is refused in
+        # O(1) while well-paying traffic still admits
+        self._floor_mult = 1
         self.lifetime = lifetime
         # sender -> {nonce -> _Entry}
         self._by_sender: dict[bytes, dict[int, _Entry]] = {}
@@ -171,7 +176,18 @@ class TxPool:
         state = self._state_view()
         if tx.nonce < state.nonce(sender):
             raise PoolError("nonce too low")
-        if tx.gas_price < self.price_floor:
+        if tx.gas_price < self.price_floor * self._floor_mult:
+            if (self._floor_mult > 1
+                    and tx.gas_price >= self.price_floor):
+                # refused only by the governor's raised floor: count
+                # it as a governed rejection, not ordinary underpricing
+                from .. import governor as GV
+
+                GV.count_rejection("txpool")
+                raise PoolError(
+                    "gas price below overload floor "
+                    f"({self.price_floor * self._floor_mult})"
+                )
             raise PoolError("gas price below floor")
         if is_staking:
             # delegated/self-staked amount must be covered up front
@@ -288,25 +304,33 @@ class TxPool:
 
     # -- maintenance -------------------------------------------------------
 
-    def _drop_applied_unlocked(self):
+    def _drop_applied_unlocked(self) -> int:
         """Prune txs whose nonce is now below the state nonce (called
         after a block commits); queued txs just above the new nonce
-        become executable implicitly (promotion is the tier REREAD)."""
+        become executable implicitly (promotion is the tier REREAD).
+        Returns how many were pruned — drop_applied's journal-rotate
+        branch gates on it, and the missing return made that branch
+        unreachable (the journal never rotated on the commit path)."""
         state = self._state_view()
+        dropped = 0
         for sender in list(self._by_sender):
             slots = self._by_sender[sender]
             floor = state.nonce(sender)
             for nonce in [n for n in slots if n < floor]:
                 del slots[nonce]
                 self._count -= 1
+                dropped += 1
             if not slots:
                 del self._by_sender[sender]
+        return dropped
 
-    def _evict_stale_unlocked(self, now: float | None = None):
+    def _evict_stale_unlocked(self, now: float | None = None) -> int:
         """Drop queued txs older than the lifetime (reference: the 3h
-        queue eviction loop)."""
+        queue eviction loop).  Returns the eviction count — the node's
+        maintenance tick logs it."""
         now = time.monotonic() if now is None else now
         state = self._state_view()
+        dropped = 0
         for sender in list(self._by_sender):
             slots = self._by_sender[sender]
             exec_top = state.nonce(sender)
@@ -319,11 +343,26 @@ class TxPool:
                 del slots[nonce]
                 self._count -= 1
                 self.evicted += 1
+                dropped += 1
             if not slots:
                 del self._by_sender[sender]
+        return dropped
 
     def __len__(self):
         return self._count
+
+    # -- governor surface ---------------------------------------------------
+
+    def set_floor_multiplier(self, mult: int) -> None:
+        """Dynamic gas-price floor (resource governor knob): the
+        effective admission floor becomes price_floor * mult."""
+        self._floor_mult = max(1, int(mult))
+
+    def fill_ratio(self) -> float:
+        """Pool occupancy 0..1 against the combined global bound — the
+        governor's queue-pressure signal for this pool."""
+        limit = self.global_slots + self.global_queue
+        return (self._count / limit) if limit else 0.0
 
 
     # -- locked public surface (see _lock above) ---------------------------

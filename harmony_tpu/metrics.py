@@ -11,8 +11,49 @@ consume; the node wires one Registry through its subsystems.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def process_sample() -> dict:
+    """Live process resources, stdlib-only (no psutil): RSS and thread
+    count from ``/proc/self/status``, open fds from ``/proc/self/fd``.
+    Platforms without procfs degrade per-signal to the best stdlib
+    fallback (``resource`` high-water RSS, ``threading`` count) or
+    ``None`` — a missing signal is simply not judged/exposed."""
+    rss = threads = fds = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("Threads:"):
+                    threads = int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    if rss is None:
+        try:
+            import resource
+            import sys
+
+            # high-water mark, not current — an honest degraded
+            # signal.  ru_maxrss units differ by platform: bytes on
+            # macOS, KiB elsewhere — and this branch only RUNS where
+            # procfs is absent, so the Linux KiB convention must not
+            # be hardcoded (a 1024x-inflated RSS would pin the
+            # governor at CRITICAL forever)
+            raw = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            rss = raw if sys.platform == "darwin" else raw * 1024
+        except (ImportError, OSError, ValueError):
+            rss = None
+    if threads is None:
+        threads = threading.active_count()
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        fds = None
+    return {"rss_bytes": rss, "open_fds": fds, "threads": threads}
 
 
 class LockedCounters:
@@ -80,6 +121,12 @@ class Counter:
         # threads; Gauge inherits this read too
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def total(self) -> float:
+        """Sum across every label combination (delta accounting over
+        a whole family — e.g. governor rejections per run)."""
+        with self._lock:
+            return sum(self._values.values())
 
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
@@ -223,10 +270,57 @@ class Registry:
         lines.append(self._sched_counters())
         lines.append(self._p2p_counters())
         lines.append(self._slash_counters())
+        lines.append(self._process_gauges())
+        lines.append(self._health_metrics())
+        lines.append(self._governor_metrics())
         prof = self._prof_counters()
         if prof:
             lines.append(prof)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _process_gauges() -> str:
+        """Process resource gauges from /proc/self (ISSUE 14 satellite:
+        the raw signals the resource governor tiers on, scrapeable even
+        where no governor is armed)."""
+        s = process_sample()
+        names = {
+            "rss_bytes": (
+                "harmony_process_rss_bytes",
+                "resident set size of this process",
+            ),
+            "open_fds": (
+                "harmony_process_open_fds",
+                "open file descriptors of this process",
+            ),
+            "threads": (
+                "harmony_process_threads",
+                "live threads of this process",
+            ),
+        }
+        out = []
+        for key, (name, help_) in names.items():
+            v = s.get(key)
+            if v is None:
+                continue  # signal unavailable on this platform
+            out.append(f"# HELP {name} {help_}\n"
+                       f"# TYPE {name} gauge\n"
+                       f"{name} {v}")
+        return "\n".join(out)
+
+    @staticmethod
+    def _health_metrics() -> str:
+        """Watchdog liveness families (health module singletons)."""
+        from . import health as HL
+
+        return HL.expose()
+
+    @staticmethod
+    def _governor_metrics() -> str:
+        """Resource-governor families (governor module singletons)."""
+        from . import governor as GV
+
+        return GV.expose()
 
     @staticmethod
     def _p2p_counters() -> str:
@@ -375,10 +469,12 @@ class Registry:
 
 class MetricsServer:
     """The node's always-on debug listener: GET /metrics (Prometheus
-    text), /debug/pprof/* (mounted from pprof.py — the richer profiles;
-    this server used to carry its own weaker stack-dump/profiler
-    copies), and /debug/trace (Chrome trace-event JSON from the span
-    tracer's bounded store — load it in Perfetto)."""
+    text), /healthz + /readyz (JSON watchdog/governor verdicts with
+    200/503 semantics — the orchestrator probes), /debug/pprof/*
+    (mounted from pprof.py — the richer profiles; this server used to
+    carry its own weaker stack-dump/profiler copies), and /debug/trace
+    (Chrome trace-event JSON from the span tracer's bounded store —
+    load it in Perfetto)."""
 
     def __init__(self, registry: Registry, port: int = 0):
         outer_registry = registry
@@ -393,10 +489,30 @@ class MetricsServer:
                     kv.split("=", 1)
                     for kv in query.split("&") if "=" in kv
                 )
+                status = 200
                 try:
                     if path == "/metrics":
                         data = outer_registry.expose().encode()
                         ctype = "text/plain; version=0.0.4"
+                    elif path == "/healthz":
+                        # per-subsystem watchdog verdicts; 503 when any
+                        # CRITICAL participant is wedged or dead — the
+                        # orchestrator's liveness probe
+                        from . import health as HL
+
+                        verdict = HL.verdicts()
+                        data = json.dumps(verdict).encode()
+                        ctype = "application/json"
+                        status = 200 if verdict["ok"] else 503
+                    elif path == "/readyz":
+                        # liveness AND the governor below its CRITICAL
+                        # shed tier — the load balancer's traffic gate
+                        from . import health as HL
+
+                        verdict = HL.readiness()
+                        data = json.dumps(verdict).encode()
+                        ctype = "application/json"
+                        status = 200 if verdict["ready"] else 503
                     elif path == "/debug/trace":
                         from . import trace as TR
 
@@ -421,7 +537,7 @@ class MetricsServer:
                 except Exception as e:  # noqa: BLE001 — debug surface
                     self.send_error(500, str(e))
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

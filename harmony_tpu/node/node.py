@@ -23,7 +23,7 @@ import queue
 import threading
 import time
 
-from .. import trace
+from .. import health, trace
 from ..consensus.fbft import Leader, RoundConfig, Validator
 from ..consensus.messages import (
     FBFTMessage,
@@ -134,6 +134,11 @@ class Node:
         self.pipelining = False
         self.block_time = 2.0
         self._last_propose = 0.0
+        # periodic pool maintenance from the live pump (ISSUE 14
+        # satellite: evict_stale existed but nothing ever called it —
+        # queued txs lived forever on a running node)
+        self.maintenance_interval_s = 30.0
+        self._last_maintenance = time.monotonic()
 
         self.log = get_logger("consensus", shard=self.chain.shard_id)
         # per-round latency lands in the metrics registry when one is
@@ -508,9 +513,14 @@ class Node:
             head=self.chain.head_number,
         )
 
+        hb = health.register(
+            f"sync.downloader[{self._health_tag()}]", max_age_s=60.0,
+        )
+
         def run():
             try:
                 for _ in range(1024):  # bounded: each pass is a batch
+                    hb.beat()
                     if self._stop.is_set():
                         break  # a stopped node must not keep WRITING
                         # to its chain store (a hard-kill + restart
@@ -521,10 +531,13 @@ class Node:
             except Exception as e:  # noqa: BLE001 — rejoin regardless
                 self.log.error("sync spin-up failed", err=str(e))
             finally:
+                hb.close()
                 self._sync_done.set()
 
         self._sync_thread = threading.Thread(target=run, daemon=True)
         self._sync_thread.start()
+        hb.bind(self._sync_thread)  # after start: an unstarted thread
+        #                             reads as dead to the watchdog
 
     def _finish_sync_if_done(self):
         """Pump-side completion: re-derive the round from the synced
@@ -1495,11 +1508,25 @@ class Node:
         if phase_timeout is not None:
             self.phase_timeout = float(phase_timeout)
         self.pipelining = True  # live mode: overlap COMMITTED + propose
+        # the pump IS the node's heartbeat: register it with the
+        # liveness watchdog (critical — a silent pump is a dead node).
+        # No restart supervisor: the loop below is already
+        # exception-tolerant, so death only follows stop()
+        hb = health.register(
+            f"consensus.pump[{self._health_tag()}]", critical=True,
+        )
 
         def loop():
             while not self._stop.is_set():
                 try:
+                    hb.beat()
                     now = time.monotonic()
+                    if now - self._last_maintenance >= (
+                        self.maintenance_interval_s
+                    ):
+                        self._last_maintenance = now
+                        if self.pool is not None:
+                            self.pool.evict_stale()
                     if now - self._last_propose >= block_time:
                         self.start_round_if_leader()
                     if (
@@ -1534,10 +1561,19 @@ class Node:
                     busy = 0
                 if not busy:
                     self._stop.wait(poll_interval)
+            hb.close()
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()
+        hb.bind(t)
         return t
+
+    def _health_tag(self) -> str:
+        """Stable participant label for this node's watchdog entries:
+        the gossip host name where one exists (unique per node in a
+        multi-node test process), else the shard id."""
+        name = getattr(self.host, "name", "")
+        return name or f"shard{self.chain.shard_id}"
 
     def stop(self):
         self._stop.set()

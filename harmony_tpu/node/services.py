@@ -28,6 +28,7 @@ class ServiceType(IntEnum):
     PPROF = 8
     ROSETTA = 9    # this framework's ids; the reference serves rosetta
     WEBSOCKET = 10  # and WS from its RPC stack, not service slots
+    MAINTENANCE = 11  # resource governor sampler + health watchdog
 
 
 class Service:

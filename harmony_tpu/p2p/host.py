@@ -410,15 +410,29 @@ class TCPHost(Host):
         self.sent_publish_frames = 0  # egress accounting (tests/metrics)
         self.sent_ihave_frames = 0
         self.served_iwant = 0
+        # liveness watchdog registration (ISSUE 14): the validate pool
+        # and the mesh heartbeat are the host's long-lived threads — a
+        # wedged validate worker silently eats a share of all gossip
+        from .. import health
+
+        self._hbs = []
         for i in range(self.VALIDATE_WORKERS):
-            threading.Thread(
-                target=self._validate_worker, daemon=True,
+            hb = health.register(f"p2p.validate[{name}#{i}]")
+            t = threading.Thread(
+                target=self._validate_worker, args=(hb,), daemon=True,
                 name=f"p2p-validate-{name}-{i}",
-            ).start()
-        threading.Thread(
-            target=self._heartbeat_loop, daemon=True,
+            )
+            t.start()
+            hb.bind(t)
+            self._hbs.append(hb)
+        mesh_hb = health.register(f"p2p.mesh[{name}]")
+        t = threading.Thread(
+            target=self._heartbeat_loop, args=(mesh_hb,), daemon=True,
             name=f"p2p-heartbeat-{name}",
-        ).start()
+        )
+        t.start()
+        mesh_hb.bind(t)
+        self._hbs.append(mesh_hb)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", listen_port))
@@ -646,8 +660,9 @@ class TCPHost(Host):
             with self._score_lock:
                 self.dropped_overflow += 1
 
-    def _validate_worker(self):
+    def _validate_worker(self, hb):
         while not self._closing:
+            hb.beat()
             try:
                 body, src_sock, frm, ip, mid = self._val_queue.get(
                     timeout=0.5
@@ -854,10 +869,11 @@ class TCPHost(Host):
             except OSError:
                 return
 
-    def _heartbeat_loop(self):
+    def _heartbeat_loop(self, hb):
         import random
 
         while not self._closing:
+            hb.beat()
             time.sleep(self.HEARTBEAT_S)
             try:
                 self._heartbeat(random)
@@ -999,6 +1015,8 @@ class TCPHost(Host):
 
     def close(self):
         self._closing = True
+        for hb in getattr(self, "_hbs", ()):
+            hb.close()
         try:
             self._srv.close()
         except OSError:

@@ -9,18 +9,34 @@ import time
 
 
 class RateLimiter:
-    """Token bucket per key (client ip, connection id, ...)."""
+    """Token bucket per key (client ip, connection id, ...).
 
-    def __init__(self, per_second: float = 100.0, burst: int = 200):
+    ``max_keys`` bounds the per-key state: past it, the stalest bucket
+    (oldest refill stamp) is evicted to admit a new key.  An attacker
+    cycling source addresses — exactly the traffic shape a limiter
+    meets — must not grow the LIMITER's own memory without bound; an
+    evicted key simply starts over with a full burst.  Eviction is
+    O(1): ``_state`` is kept in touch order (every ``allow`` re-stamps
+    and re-inserts its key, so dict order IS refill-stamp order) and
+    the front entry is the stalest — a full table must not buy every
+    new-key admission a ``max_keys`` scan under the lock precisely
+    when the node is already pressured."""
+
+    def __init__(self, per_second: float = 100.0, burst: int = 200,
+                 max_keys: int = 4096):
         self.rate = per_second
         self.burst = burst
+        self.max_keys = max_keys
         self._state: dict = {}
         self._lock = threading.Lock()
 
     def allow(self, key: str) -> bool:
         now = time.monotonic()
         with self._lock:
-            tokens, last = self._state.get(key, (self.burst, now))
+            entry = self._state.pop(key, None)
+            if entry is None and len(self._state) >= self.max_keys:
+                del self._state[next(iter(self._state))]
+            tokens, last = entry if entry is not None else (self.burst, now)
             tokens = min(self.burst, tokens + (now - last) * self.rate)
             if tokens < 1.0:
                 self._state[key] = (tokens, now)

@@ -112,6 +112,16 @@ class RPCServer:
                     self.send_response(429)
                     self.end_headers()
                     return
+                # resource-governor admission (ISSUE 14): PRESSURED
+                # rate-limits per client, CRITICAL refuses outright —
+                # a node past rated capacity serves 429s, not OOM kills
+                from .. import governor as GV
+
+                if not GV.admit_ingress(ip, surface="rpc"):
+                    self.send_response(429)
+                    self.send_header("Retry-After", "1")
+                    self.end_headers()
+                    return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))

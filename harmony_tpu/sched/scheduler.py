@@ -57,6 +57,7 @@ import time
 from collections import deque
 from enum import IntEnum
 
+from .. import faultinject as FI
 from .. import trace
 from ..log import get_logger
 from ..metrics import Counter, Gauge, Histogram, LockedCounters
@@ -75,6 +76,16 @@ class Lane(IntEnum):
 
 LANE_NAMES = {Lane.CONSENSUS: "consensus", Lane.SYNC: "sync",
               Lane.INGRESS: "ingress"}
+
+
+def max_queue_depth() -> float:
+    """Deepest lane's queue depth — the governor's pressure signal and
+    the soak harness's stationarity series read the SAME number through
+    this one accessor so lane renames can't silently diverge them."""
+    return max(
+        (QUEUE_DEPTH.value(lane=name) for name in LANE_NAMES.values()),
+        default=0.0,
+    )
 
 # -- metrics singletons (exposed via metrics.Registry.expose) ----------------
 
@@ -235,10 +246,13 @@ class VerifyScheduler:
         self._backend_batches: deque = deque()
         self._backend_thread: threading.Thread | None = None
         self._ewma_dispatch_s = 0.0
+        self._hb = None  # health.Heartbeat once start() registers it
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "VerifyScheduler":
+        from .. import health
+
         with self._cond:
             if self._running or self._manual:
                 return self
@@ -247,7 +261,45 @@ class VerifyScheduler:
             target=self._loop, name="sched-flush", daemon=True
         )
         self._thread.start()
+        # watchdog registration: the flush thread is CRITICAL (every
+        # signature check funnels through it) and restart-SAFE when
+        # dead — its queues live on the scheduler object, so a fresh
+        # loop resumes exactly where the dead one stopped
+        self._hb = health.register(
+            "sched.flush", thread=self._thread, critical=True,
+            restart=self._revive,
+        )
         return self
+
+    def _revive(self) -> bool:
+        """Watchdog restart hook: respawn the flush loop if (and only
+        if) the scheduler is still running and its thread is dead.  The
+        queued requests are untouched — the new loop drains them.
+        Returns False when it declines (racing a stop(), or the thread
+        is alive after all) so the watchdog does not count a restart
+        that never ran."""
+        with self._cond:
+            if not self._running:
+                return False
+            t = self._thread
+            if t is not None and t.is_alive():
+                return False
+        thread = threading.Thread(
+            target=self._loop, name="sched-flush", daemon=True
+        )
+        # started BEFORE being published: stop() joins self._thread,
+        # and joining a never-started thread raises RuntimeError — a
+        # stop() racing this window must find either the old dead
+        # thread or a joinable live one.  If stop() wins the race the
+        # fresh loop sees _running False and exits by itself.
+        thread.start()
+        with self._cond:
+            if not self._running:
+                return False
+            self._thread = thread
+        if self._hb is not None:
+            self._hb.bind(thread)
+        return True
 
     def stop(self) -> None:
         with self._cond:
@@ -270,6 +322,9 @@ class VerifyScheduler:
         if self._backend_thread is not None:
             self._backend_thread.join(timeout=5.0)
             self._backend_thread = None
+        if self._hb is not None:
+            self._hb.close()
+            self._hb = None
 
     # -- submission ----------------------------------------------------------
 
@@ -310,6 +365,17 @@ class VerifyScheduler:
             if req.kind != "backend" and self._breaker_open():
                 self._shed(req, "breaker_open")
                 return req.future
+            # resource-governor degradation: INGRESS sheds from the
+            # PRESSURED tier, SYNC from CRITICAL, CONSENSUS never —
+            # overload must not buy queue depth ahead of quorum proofs.
+            # The shed verdict is the exact CPU-reference fallback on
+            # the caller's thread (correct, just not batched).
+            if req.lane is not Lane.CONSENSUS:
+                from .. import governor as GV
+
+                if GV.should_shed(req.lane):
+                    self._shed(req, "governor")
+                    return req.future
             # fail-fast admission: if the budget cannot survive the
             # queue already ahead of us, refuse before anyone waits
             if req.deadline is not None:
@@ -430,14 +496,29 @@ class VerifyScheduler:
 
     def _loop(self) -> None:
         while True:
+            # re-read each pass: start() registers the heartbeat only
+            # AFTER the thread is running
+            hb = self._hb
             kind = batch = expired = None
+            # the wedged_thread_recovery chaos scenario's kill switch:
+            # an armed exc here dies like any unexpected flush-loop
+            # error would — outside every per-batch catch — and the
+            # health watchdog must detect the dead thread and revive it
+            FI.fire("sched.flush")
+            if hb is not None:
+                hb.beat()
             # the bucket width resolves OUTSIDE _cond: its first call
             # may run the device backend probe (a bounded Thread.join)
             # and nothing blocking belongs under the queue lock (GL06)
             target = self._target_batch()
             with self._cond:
                 while self._running and not any(self._lanes.values()):
+                    if hb is not None:
+                        hb.idle()  # empty queue: parked healthy, not
+                        #            wedged — the watchdog skips idle
                     self._cond.wait()
+                if hb is not None:
+                    hb.beat()
                 if not self._running:
                     return
                 lane = self._choose_lane()

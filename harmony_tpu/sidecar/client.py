@@ -75,10 +75,18 @@ class _AsyncCall:
 class SidecarClient:
     def __init__(self, address, connect_timeout: float = 5.0,
                  call_timeout: float = 10.0,
-                 retry: RetryPolicy | None = None):
+                 retry: RetryPolicy | None = None,
+                 label: str = ""):
         self._address = address
         self._connect_timeout = connect_timeout
         self._call_timeout = call_timeout
+        # watchdog participant label: one client = one monitored reader
+        # ("sidecar.reader[<label>]"); callers running several clients
+        # in one process (the chaos localnet) pass distinct labels
+        self._label = label or (
+            address if isinstance(address, str)
+            else f"{address[0]}:{address[1]}"
+        )
         self._retry = retry or RetryPolicy(
             attempts=3, base_delay_s=0.05, max_delay_s=0.5
         )
@@ -183,11 +191,30 @@ class SidecarClient:
         an id nobody is waiting on — is a stream desync: fail closed
         (and fire the flight recorder; a desynced verification stream
         is exactly the snapshot an operator wants)."""
+        from .. import health
+
+        # the reader registers with the liveness watchdog: parked in
+        # recv with no traffic it is IDLE (healthy); silent while BUSY
+        # past max_age is a wedged reader — the exact fault the
+        # wedged_thread_recovery scenario injects via sidecar.frame.
+        # No restart supervisor: recovery is the client's own lazy
+        # redial + committee replay, which a dead reader triggers
+        # through _drop on every exit path below.
+        hb = health.register(f"sidecar.reader[{self._label}]",
+                             thread=threading.current_thread())
         desync = None
         while True:
             try:
-                FI.fire("sidecar.frame")
-                frame = P.read_frame(sock)
+                hb.beat()
+                # keyed by client label: scenarios can wedge ONE named
+                # reader (un-keyed arms still match every client)
+                FI.fire("sidecar.frame", key=self._label)
+                hb.idle()  # about to park in recv: quiet != wedged.
+                # The on_header hook flips back to busy the moment a
+                # frame is in flight, so a peer stalling MID-frame is
+                # a detectable wedge, not an invisible idle wait
+                frame = P.read_frame(sock, on_header=hb.beat)
+                hb.beat()
             except ValueError as e:
                 desync = f"garbage frame: {e}"
                 break  # never trust the stream again
@@ -203,6 +230,7 @@ class SidecarClient:
                 break  # reply to nobody: mid-frame desync, fail closed
             slot.frame = (rtype, rbody)
             slot.event.set()
+        hb.close(reason="desync" if desync is not None else "eof")
         self._drop(sock)
         if desync is not None:
             _log.warn("sidecar stream desync", error=desync)

@@ -89,11 +89,20 @@ def unpack_frame(data: bytes):
     return msg_type, request_id, data[5:]
 
 
-def read_frame(sock):
-    """Blocking read of one frame from a socket; None on clean EOF."""
+def read_frame(sock, on_header=None):
+    """Blocking read of one frame from a socket; None on clean EOF.
+
+    ``on_header`` (optional zero-arg callable) fires the moment the
+    length header has arrived — i.e. when a frame is KNOWN to be in
+    flight.  The client reader uses it to flip its watchdog heartbeat
+    from idle (quietly parked awaiting traffic) to busy: a peer that
+    starts a frame and then stalls mid-body is a wedge the watchdog
+    must see, not an idle wait."""
     hdr = _read_exact(sock, 4)
     if hdr is None:
         return None
+    if on_header is not None:
+        on_header()
     (frame_len,) = struct.unpack("<I", hdr)
     if not 5 <= frame_len <= MAX_FRAME:
         raise ValueError(f"bad frame length {frame_len}")

@@ -60,6 +60,21 @@ class Downloader:
             return None
         return Deadline.after(self.request_deadline_s)
 
+    def _window(self) -> int:
+        """Effective fetch/verify window: the configured batch, shrunk
+        by the resource governor's tier (PRESSURED x1/2, CRITICAL x1/4
+        — catch-up keeps moving under overload, in smaller bites that
+        hold less memory and yield the device queue sooner)."""
+        from .. import governor as GV
+
+        scale = GV.sync_window_scale()
+        if scale >= 1.0:
+            return self.batch
+        # floor of 8 keeps catch-up moving, but never ABOVE the
+        # operator's configured batch — pressure must not enlarge the
+        # window for small-batch downloaders
+        return min(self.batch, max(8, int(self.batch * scale)))
+
     def _peers(self) -> list:
         """Healthy peers, in configured order."""
         return [c for c in self.clients if id(c) not in self._excluded]
@@ -196,7 +211,7 @@ class Downloader:
         num = head + 1
         last_inserted = head
         while num <= res.target:
-            count = min(self.batch, res.target - num + 1)
+            count = min(self._window(), res.target - num + 1)
             hashes = self.agreed_hashes(num, count)
             if not hashes:
                 res.errors.append(f"no hash agreement at {num}")
@@ -277,7 +292,8 @@ class Downloader:
             )
         while self.chain.head_number < res.target:
             start = self.chain.head_number + 1
-            count = min(self.batch, res.target - self.chain.head_number)
+            count = min(self._window(),
+                        res.target - self.chain.head_number)
             hashes = self.agreed_hashes(start, count)
             if not hashes:
                 res.errors.append(f"no hash agreement at {start}")

@@ -10,6 +10,7 @@ the double-sign detector and on view changes.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import urllib.request
@@ -26,6 +27,12 @@ _log = get_logger("webhooks")
 # its double-sign report; one that stays down costs three bounded
 # attempts and a logged drop, never a hung thread pile-up
 _POST_RETRY = RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=1.0)
+
+# unique watchdog participant per delivery: concurrent POSTs must not
+# evict each other's registration (register() replaces same names — a
+# wedged OLDER delivery would go silently unmonitored); closed handles
+# deregister, and the registry's cardinality bound evicts leaks
+_SENDER_SEQ = itertools.count(1)
 
 
 class Hooks:
@@ -68,13 +75,35 @@ def http_post_hook(url: str, timeout: float = 5.0,
 
     def hook(payload: dict):
         def send():
-            req = urllib.request.Request(
-                url,
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
+            from . import health
+
+            # delivery threads are short-lived but BOUNDED: register
+            # with the watchdog for their worst-case budget (attempts x
+            # (timeout + backoff)) so a POST wedged past it — a sink
+            # that accepts the connection and never answers — surfaces
+            # instead of silently pinning threads
+            budget = policy.attempts * (timeout + policy.max_delay_s) + 5
+            # the request is built BEFORE the heartbeat registers: a
+            # payload json.dumps can raise (bytes in evidence fields),
+            # and raising between register and the try/finally below
+            # would leak a permanently-dead participant per delivery
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+            except (TypeError, ValueError) as e:
+                _log.warn("webhook payload not serializable",
+                          url=url, error=str(e))
+                return
+            hb = health.register(
+                f"webhook.sender#{next(_SENDER_SEQ)}", max_age_s=budget,
+                thread=threading.current_thread(),
             )
 
             def attempt():
+                hb.beat()
                 FI.fire("webhook.post")
                 urllib.request.urlopen(req, timeout=timeout).close()
 
@@ -84,6 +113,8 @@ def http_post_hook(url: str, timeout: float = 5.0,
                 _log.warn("webhook POST dropped after retries",
                           url=url, error=str(e),
                           attempts=policy.attempts)
+            finally:
+                hb.close()
 
         threading.Thread(target=send, daemon=True).start()
 

@@ -144,6 +144,7 @@ def test_scenario_registry_names_and_shape():
         "leader_kill_restart", "rolling_restart",
         "byz_equivocating_leader", "byz_double_voter_slashed",
         "byz_invalid_proposal_flood",
+        "overload_storm", "wedged_thread_recovery",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):
@@ -156,6 +157,105 @@ def test_scenario_registry_names_and_shape():
         # quick runs must genuinely be scaled down
         assert (builder(quick=True).window_s
                 <= builder(quick=False).window_s)
+
+
+# -- load-relative phase windows (ISSUE 14 deflake) --------------------------
+
+
+def _hold_env(phase):
+    """Minimal RunEnv stand-in for driving _timeline directly: one
+    literal-partition phase, no kills, an empty committee."""
+    import types
+
+    return types.SimpleNamespace(
+        scenario=types.SimpleNamespace(phases=(phase,)),
+        handles=[],
+        net=types.SimpleNamespace(partitioned=set()),
+        shard_head=lambda shard: 0,
+        by_shard=lambda shard: [],
+        data={},
+    )
+
+
+def _drive_timeline(env, stop):
+    import threading
+
+    from harmony_tpu.chaostest import runner as R
+
+    t = threading.Thread(
+        target=R._timeline, args=(env, stop, time.monotonic(), []),
+        daemon=True,
+    )
+    t.start()
+    return t
+
+
+def test_phase_hold_until_outlasts_duration():
+    """A phase with hold_until stays armed past duration_s until the
+    predicate proves the fault did its job — the view_change_storm
+    heal must not race a loaded box's VC ladder."""
+    import threading
+
+    from harmony_tpu.chaostest.scenario import Phase
+
+    done = threading.Event()
+    phase = Phase(
+        "hold", at_s=0.0, duration_s=0.1, partition=("n0",),
+        hold_until=lambda env: done.is_set(), hold_max_s=30.0,
+    )
+    env = _hold_env(phase)
+    stop = threading.Event()
+    t = _drive_timeline(env, stop)
+    try:
+        deadline = time.monotonic() + 5.0
+        while "n0" not in env.net.partitioned:
+            assert time.monotonic() < deadline, "phase never armed"
+            time.sleep(0.01)
+        time.sleep(0.4)  # well past duration_s
+        assert "n0" in env.net.partitioned, (
+            "healed on wall clock despite an unsatisfied hold_until"
+        )
+        done.set()
+        deadline = time.monotonic() + 5.0
+        while "n0" in env.net.partitioned:
+            assert time.monotonic() < deadline, "never healed"
+            time.sleep(0.01)
+        t.join(5.0)
+        assert not t.is_alive()
+    finally:
+        stop.set()
+
+
+def test_phase_hold_max_caps_a_never_true_predicate():
+    """hold_max_s bounds the hold: a fault whose observable never
+    materializes heals anyway (and lets the invariant fail the run)
+    instead of wedging the timeline."""
+    import threading
+
+    from harmony_tpu.chaostest.scenario import Phase
+
+    phase = Phase(
+        "cap", at_s=0.0, duration_s=0.05, partition=("n0",),
+        hold_until=lambda env: False, hold_max_s=0.4,
+    )
+    env = _hold_env(phase)
+    stop = threading.Event()
+    t = _drive_timeline(env, stop)
+    try:
+        deadline = time.monotonic() + 5.0
+        while "n0" not in env.net.partitioned:
+            assert time.monotonic() < deadline, "phase never armed"
+            time.sleep(0.01)
+        deadline = time.monotonic() + 5.0
+        while "n0" in env.net.partitioned:
+            assert time.monotonic() < deadline, (
+                "hold_max_s did not cap a never-true predicate"
+            )
+            time.sleep(0.01)
+        t.join(5.0)
+        assert not t.is_alive()
+    finally:
+        stop.set()
 
 
 # -- the view-change quorum-mid-drain regression -----------------------------

@@ -1,0 +1,317 @@
+"""Resource-governor tier (ISSUE 14): tier transitions with
+hysteresis + dwell, every knob it drives (tx-pool overload floor,
+ingress admission, scheduler sheds, sync window), and the maintenance
+tick that finally calls evict_stale on a running node."""
+
+import time
+
+import pytest
+
+from harmony_tpu import governor as GV
+from harmony_tpu import health as HL
+from harmony_tpu.governor import Limits, ResourceGovernor, Tier
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    HL.configure(enabled=False)
+    yield
+    GV.uninstall()
+    HL.reset()
+
+
+def _gov(sample, clock=None, **kw):
+    kw.setdefault("limits", Limits(
+        queue_pressured=100, queue_critical=200,
+        pool_pressured=0.5, pool_critical=0.9,
+        threads_pressured=500, threads_critical=1000,
+        hysteresis=0.8, dwell_s=1.0,
+    ))
+    return ResourceGovernor(
+        sample_fn=lambda: dict(sample),
+        clock=clock or time.monotonic, **kw,
+    )
+
+
+# -- the tier state machine ---------------------------------------------------
+
+
+def test_escalation_is_immediate_worst_signal_wins():
+    sample = {"queue_depth": 0}
+    gov = _gov(sample)
+    assert gov.sample_once() is Tier.NORMAL
+    sample["queue_depth"] = 150
+    assert gov.sample_once() is Tier.PRESSURED
+    sample["queue_depth"] = 0
+    sample["pool_fill"] = 0.95  # a DIFFERENT signal goes critical
+    assert gov.sample_once() is Tier.CRITICAL
+    assert gov.peak is Tier.CRITICAL
+
+
+def test_deescalation_needs_dwell_and_hysteresis():
+    now = [0.0]
+    sample = {"queue_depth": 150}
+    gov = _gov(sample, clock=lambda: now[0])
+    assert gov.sample_once() is Tier.PRESSURED
+    # clear drop, but the dwell (1 s since the transition) not served
+    sample["queue_depth"] = 10
+    now[0] += 0.5
+    assert gov.sample_once() is Tier.PRESSURED
+    # BELOW the enter threshold but above exit (enter 100 * hysteresis
+    # 0.8 = 80): the tier holds no matter how long
+    sample["queue_depth"] = 90
+    now[0] += 10.0
+    assert gov.sample_once() is Tier.PRESSURED
+    # clear headroom + dwell served -> steps down
+    sample["queue_depth"] = 10
+    now[0] += 1.0
+    assert gov.sample_once() is Tier.NORMAL
+
+
+def test_deescalation_steps_one_tier_per_dwell():
+    now = [0.0]
+    sample = {"queue_depth": 500}
+    gov = _gov(sample, clock=lambda: now[0])
+    assert gov.sample_once() is Tier.CRITICAL
+    sample["queue_depth"] = 0
+    now[0] += 2.0
+    assert gov.sample_once() is Tier.PRESSURED  # one step, not a jump
+    now[0] += 2.0
+    assert gov.sample_once() is Tier.NORMAL
+
+
+def test_missing_signals_are_not_judged():
+    gov = _gov({"rss_bytes": None, "pool_fill": None})
+    assert gov.sample_once() is Tier.NORMAL
+
+
+def test_transition_metrics_and_state_gauge():
+    before = GV.TRANSITIONS.value(**{"from": "normal", "to": "pressured"})
+    sample = {"queue_depth": 150}
+    gov = _gov(sample)
+    gov.sample_once()
+    assert GV.TRANSITIONS.value(
+        **{"from": "normal", "to": "pressured"}
+    ) == before + 1
+    assert GV.STATE.value() == 1.0
+    text = GV.expose()
+    assert "harmony_governor_state" in text
+    assert "harmony_governor_transitions_total" in text
+
+
+# -- knob: tx-pool overload floor --------------------------------------------
+
+
+def _mk_pool(**kw):
+    from harmony_tpu.core.tx_pool import TxPool
+
+    class _Stub:
+        def nonce(self, addr):
+            return 0
+
+        def balance(self, addr):
+            return 10**30
+
+    return TxPool(2, 0, _Stub, **kw)
+
+
+def _tx(nonce=0, gas_price=1):
+    from harmony_tpu.core.types import Transaction
+
+    return Transaction(nonce=nonce, gas_price=gas_price,
+                       gas_limit=21_000, shard_id=0, to_shard=0,
+                       to=b"\x2d" * 20, value=1)
+
+
+def test_pool_floor_follows_tiers():
+    from harmony_tpu.core.tx_pool import PoolError
+
+    pool = _mk_pool()
+    sample = {"queue_depth": 0}
+    gov = _gov(sample)
+    gov.attach_pool(pool)
+    sender = b"\x41" * 20
+    pool.add(_tx(nonce=0), sender=sender)  # floor 1 admits price 1
+    sample["queue_depth"] = 150
+    gov.sample_once()  # PRESSURED: floor x4
+    before = GV.rejections_total()
+    with pytest.raises(PoolError, match="overload floor"):
+        pool.add(_tx(nonce=1), sender=sender)
+    assert GV.rejections_total() == before + 1
+    pool.add(_tx(nonce=1, gas_price=4), sender=sender)  # pays the floor
+    # recovery restores the configured floor
+    sample["queue_depth"] = 0
+    time.sleep(0)  # dwell is against the real clock here
+    gov.limits = Limits(dwell_s=0.0, queue_pressured=100,
+                        queue_critical=200)
+    gov.sample_once()
+    assert gov.state() is Tier.NORMAL
+    pool.add(_tx(nonce=2), sender=sender)
+
+
+def test_pool_fill_ratio():
+    pool = _mk_pool(cap=10)
+    sender = b"\x42" * 20
+    assert pool.fill_ratio() == 0.0
+    for n in range(5):
+        pool.add(_tx(nonce=n), sender=sender)
+    assert pool.fill_ratio() == 0.5
+
+
+def test_ordinary_floor_rejection_is_not_counted_as_governed():
+    from harmony_tpu.core.tx_pool import PoolError
+
+    pool = _mk_pool(price_floor=10)
+    before = GV.rejections_total()
+    with pytest.raises(PoolError, match="below floor"):
+        pool.add(_tx(gas_price=5), sender=b"\x43" * 20)
+    assert GV.rejections_total() == before
+
+
+# -- knob: ingress admission --------------------------------------------------
+
+
+def test_admit_ingress_tiers():
+    sample = {"queue_depth": 0}
+    gov = _gov(sample, pressured_ingress_rate=1.0)
+    GV.install(gov)
+    assert GV.admit_ingress("1.2.3.4") is True  # NORMAL: open
+    sample["queue_depth"] = 150
+    gov.sample_once()
+    # PRESSURED: token-bucket limited per key (burst 2 at rate 1/s)
+    allowed = [gov.admit_ingress("1.2.3.4") for _ in range(4)]
+    assert allowed[:2] == [True, True] and allowed[-1] is False
+    assert gov.admit_ingress("5.6.7.8") is True  # per-key isolation
+    sample["queue_depth"] = 500
+    gov.sample_once()
+    before = GV.rejections_total()
+    assert gov.admit_ingress("1.2.3.4") is False  # CRITICAL: refused
+    assert GV.rejections_total() == before + 1
+
+
+def test_uninstalled_helpers_are_open():
+    from harmony_tpu.sched.scheduler import Lane
+
+    GV.uninstall()
+    assert GV.admit_ingress("x") is True
+    assert GV.should_shed(Lane.INGRESS) is False
+    assert GV.sync_window_scale() == 1.0
+
+
+# -- knob: scheduler sheds ----------------------------------------------------
+
+
+def test_should_shed_matrix():
+    from harmony_tpu.sched.scheduler import Lane
+
+    sample = {"queue_depth": 0}
+    gov = _gov(sample)
+    for lane in Lane:
+        assert gov.should_shed(lane) is False
+    gov._state = Tier.PRESSURED
+    assert gov.should_shed(Lane.INGRESS) is True
+    assert gov.should_shed(Lane.SYNC) is False
+    assert gov.should_shed(Lane.CONSENSUS) is False
+    gov._state = Tier.CRITICAL
+    assert gov.should_shed(Lane.INGRESS) is True
+    assert gov.should_shed(Lane.SYNC) is True
+    assert gov.should_shed(Lane.CONSENSUS) is False  # NEVER
+
+
+def test_scheduler_sheds_governed_lanes_to_fallback():
+    """A CRITICAL governor sheds INGRESS/SYNC submissions to the
+    caller-thread fallback (counted, correct), while CONSENSUS still
+    queues for the device."""
+    from harmony_tpu.sched.scheduler import (
+        SHED, Lane, VerifyScheduler,
+    )
+
+    class _StubClient:
+        def agg_verify(self, *args, deadline=None):
+            return True
+
+    gov = _gov({"queue_depth": 0})
+    gov._state = Tier.CRITICAL
+    GV.install(gov)
+    sched = VerifyScheduler(manual=True)
+    before = SHED.value(lane="ingress", reason="governor")
+    fut = sched.submit_backend(
+        _StubClient(), 0, 0, b"p", b"\xff", b"s", lane=Lane.INGRESS,
+    )
+    assert fut.result(1.0) is True  # the fallback ran the stub call
+    assert SHED.value(
+        lane="ingress", reason="governor"
+    ) == before + 1
+    # consensus traffic is untouched: it queues instead of shedding
+    fut2 = sched.submit_backend(
+        _StubClient(), 0, 0, b"p", b"\xff", b"s", lane=Lane.CONSENSUS,
+    )
+    assert not fut2.done()
+    assert len(sched._lanes[Lane.CONSENSUS]) == 1
+
+
+# -- knob: sync window --------------------------------------------------------
+
+
+def test_sync_window_shrinks_with_tier():
+    from harmony_tpu.sync.staged import Downloader
+
+    dl = Downloader(chain=None, clients=[], batch=64)
+    assert dl._window() == 64
+    gov = _gov({"queue_depth": 0})
+    GV.install(gov)
+    gov._state = Tier.PRESSURED
+    assert dl._window() == 32
+    gov._state = Tier.CRITICAL
+    assert dl._window() == 16
+    gov._state = Tier.NORMAL
+    assert dl._window() == 64
+
+
+# -- the maintenance tick -----------------------------------------------------
+
+
+def test_running_node_ticks_evict_stale(monkeypatch):
+    """The live pump must periodically evict stale queued txs — the
+    ISSUE 14 satellite: evict_stale existed, nothing ever called it."""
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    from harmony_tpu.core.blockchain import Blockchain
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.core.kv import MemKV
+    from harmony_tpu.core.tx_pool import TxPool
+    from harmony_tpu.multibls import PrivateKeys
+    from harmony_tpu.node.node import Node
+    from harmony_tpu.node.registry import Registry
+    from harmony_tpu.p2p import InProcessNetwork
+
+    genesis, ecdsa_keys, bls_keys = dev_genesis(n_keys=1)
+    chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    pool = TxPool(2, 0, chain.state, lifetime=0.05)
+    reg = Registry(blockchain=chain, txpool=pool,
+                   host=InProcessNetwork().host("n0"))
+    node = Node(reg, PrivateKeys.from_keys(bls_keys))
+    node.maintenance_interval_s = 0.05
+    # a FUTURE-nonce tx parks in the queued tier and can only leave
+    # via lifetime eviction
+    sender = ecdsa_keys[0].address()
+    pool.add(_tx(nonce=7), sender=sender)
+    assert len(pool) == 1
+    pump = node.run_forever(poll_interval=0.01, block_time=60.0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while len(pool) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(pool) == 0
+        assert pool.evicted == 1
+    finally:
+        node.stop()
+        pump.join(timeout=5.0)
+
+
+def test_evict_stale_returns_count():
+    pool = _mk_pool(lifetime=0.01)
+    sender = b"\x44" * 20
+    pool.add(_tx(nonce=5), sender=sender)  # queued (future nonce)
+    time.sleep(0.03)
+    assert pool.evict_stale() == 1
+    assert pool.evict_stale() == 0

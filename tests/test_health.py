@@ -1,0 +1,280 @@
+"""Health subsystem tier (ISSUE 14): heartbeat states, watchdog
+detection + flight-recorder evidence + supervised restart, the
+/healthz + /readyz endpoints, and the metrics families."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from harmony_tpu import health as HL
+from harmony_tpu import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    HL.reset()
+    trace.reset()
+    yield
+    HL.reset()
+    trace.reset()
+
+
+# -- heartbeat states ---------------------------------------------------------
+
+
+def test_states_ok_stale_idle_closed():
+    HL.configure(enabled=False)  # pure bookkeeping: no watchdog thread
+    hb = HL.register("a", max_age_s=0.05)
+    assert hb.state() == "ok"
+    time.sleep(0.08)
+    assert hb.state() == "stale"  # busy + silent past max_age
+    hb.beat()
+    assert hb.state() == "ok"
+    hb.idle()
+    time.sleep(0.08)
+    assert hb.state() == "idle"  # declared-healthy parking never stales
+    hb.close()
+    assert hb.state() == "closed"
+    assert all(p.name != "a" for p in HL.participants())
+
+
+def test_dead_thread_state():
+    HL.configure(enabled=False)
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    hb = HL.register("gone", thread=t, max_age_s=100.0)
+    assert hb.state() == "dead"  # thread liveness beats beat age
+
+
+def test_close_is_identity_guarded():
+    """A moribund participant closing late must not deregister the
+    successor that took its name."""
+    HL.configure(enabled=False)
+    old = HL.register("reader")
+    new = HL.register("reader")  # replacement (redial path)
+    old.close()
+    assert HL.participants() == [new]
+
+
+# -- the watchdog -------------------------------------------------------------
+
+
+def test_watchdog_detects_stale_dumps_once_and_sees_recovery(tmp_path):
+    HL.configure(enabled=False)  # drive check_once deterministically
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=0)
+    hb = HL.register("wedgy", max_age_s=0.05)
+    time.sleep(0.08)
+    assert HL.check_once()["wedgy"] == "stale"
+    assert HL.EVENTS["stale"] == 1
+    dumps = [p for p in trace.dumps()]
+    assert len(dumps) == 1
+    assert json.load(open(dumps[0]))["kind"] == "watchdog.wedgy"
+    # still stale next sweep: no double count, no second dump
+    assert HL.check_once()["wedgy"] == "stale"
+    assert HL.EVENTS["stale"] == 1
+    assert len(trace.dumps()) == 1
+    # the thread beats again: recovery observed exactly once
+    hb.beat()
+    assert HL.check_once()["wedgy"] == "ok"
+    assert HL.EVENTS["recovered"] == 1
+
+
+def test_watchdog_restarts_dead_participant():
+    HL.configure(enabled=False)
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    revived = []
+
+    def restart():
+        live = threading.Thread(target=time.sleep, args=(5.0,),
+                                daemon=True)
+        live.start()
+        hb.bind(live)
+        revived.append(live)
+
+    hb = HL.register("svc", thread=t, restart=restart)
+    states = HL.check_once()
+    assert states["svc"] == "dead"
+    assert HL.EVENTS["dead"] == 1
+    assert HL.EVENTS["restart"] == 1
+    assert revived and hb.state() == "ok"
+
+
+def test_watchdog_restart_failure_is_counted_not_fatal():
+    HL.configure(enabled=False)
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+
+    def broken():
+        raise RuntimeError("no resurrection today")
+
+    HL.register("doomed", thread=t, restart=broken)
+    HL.check_once()  # must not raise
+    assert HL.EVENTS["restart_failed"] == 1
+
+
+def test_close_while_flagged_counts_recovery():
+    """A wedged participant exiting through its own fail-closed path
+    (reader drops the connection, client redials) IS a recovery."""
+    HL.configure(enabled=False)
+    hb = HL.register("reader", max_age_s=0.05)
+    time.sleep(0.08)
+    HL.check_once()
+    assert HL.EVENTS["stale"] == 1
+    hb.close(reason="desync")
+    assert HL.EVENTS["recovered"] == 1
+
+
+def test_registry_cardinality_bound():
+    HL.configure(enabled=False)
+    keeper = HL.register("keeper", critical=True)
+    for i in range(HL._MAX_PARTICIPANTS + 8):
+        HL.register(f"transient{i}")
+    names = {p.name for p in HL.participants()}
+    assert len(names) <= HL._MAX_PARTICIPANTS
+    assert keeper.name in names  # critical entries outlive the purge
+
+
+# -- verdict surfaces ---------------------------------------------------------
+
+
+def test_verdicts_and_critical_gating():
+    HL.configure(enabled=False)
+    HL.register("fine")
+    sick = HL.register("sick", max_age_s=0.01)
+    time.sleep(0.03)
+    v = HL.verdicts()
+    assert v["ok"] is True  # degraded but not critical
+    assert v["degraded"] == ["sick"]
+    assert v["participants"]["sick"]["state"] == "stale"
+    sick.critical = True
+    assert HL.verdicts()["ok"] is False
+    assert HL.healthy() is False
+
+
+def test_readiness_reflects_governor_tier():
+    from harmony_tpu import governor as GV
+
+    HL.configure(enabled=False)
+    HL.register("pump", critical=True)
+    assert HL.readiness()["ready"] is True
+    gov = GV.ResourceGovernor(sample_fn=lambda: {})
+    gov._state = GV.Tier.CRITICAL
+    GV.install(gov)
+    try:
+        r = HL.readiness()
+        assert r["ready"] is False
+        assert r["governor"] == "critical"
+        assert r["health_ok"] is True  # alive, just shedding
+    finally:
+        GV.uninstall()
+
+
+def test_healthz_readyz_http(tmp_path):
+    """The MetricsServer serves both probes with 200/503 semantics."""
+    from harmony_tpu.metrics import MetricsServer, Registry
+
+    HL.configure(enabled=False)
+    pump = HL.register("pump", critical=True, max_age_s=0.2)
+    srv = MetricsServer(Registry(), port=0).start()
+    try:
+        def get(path):
+            try:
+                resp = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=10
+                )
+                return resp.status, json.load(resp)
+            except urllib.error.HTTPError as e:
+                return e.code, json.load(e)
+
+        status, body = get("/healthz")
+        assert status == 200 and body["ok"] is True
+        assert "pump" in body["participants"]
+        status, body = get("/readyz")
+        assert status == 200 and body["ready"] is True
+        time.sleep(0.3)  # the critical pump goes silent -> stale
+        status, body = get("/healthz")
+        assert status == 503 and body["ok"] is False
+        assert body["participants"]["pump"]["state"] == "stale"
+        status, body = get("/readyz")
+        assert status == 503 and body["ready"] is False
+        pump.beat()
+        status, _ = get("/healthz")
+        assert status == 200
+    finally:
+        srv.stop()
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_exposition_families(tmp_path):
+    from harmony_tpu.metrics import Registry
+
+    HL.configure(enabled=False)
+    hb = HL.register("pump", max_age_s=0.05)
+    time.sleep(0.08)
+    HL.check_once()
+    hb.beat()
+    HL.check_once()
+    hb.max_age_s = 60.0  # the scrape below must see it healthy
+    hb.beat()
+    text = Registry().expose()
+    assert 'harmony_health_up{participant="pump"} 1' in text
+    assert "harmony_health_beat_age_seconds" in text
+    assert 'harmony_health_watchdog_total{event="stale"} 1' in text
+    assert 'harmony_health_watchdog_total{event="recovered"} 1' in text
+    # the process gauges (ISSUE 14 satellite) ride the same exposition
+    assert "harmony_process_threads" in text
+    from harmony_tpu.metrics import process_sample
+
+    s = process_sample()
+    if s["rss_bytes"] is not None:
+        assert "harmony_process_rss_bytes" in text
+    if s["open_fds"] is not None:
+        assert "harmony_process_open_fds" in text
+
+
+def test_process_sample_shape():
+    from harmony_tpu.metrics import process_sample
+
+    s = process_sample()
+    assert set(s) == {"rss_bytes", "open_fds", "threads"}
+    assert s["threads"] >= 1
+    if s["rss_bytes"] is not None:
+        assert s["rss_bytes"] > 1 << 20  # a Python process holds >1MiB
+    if s["open_fds"] is not None:
+        assert s["open_fds"] >= 3  # stdio at minimum
+
+
+# -- the live watchdog thread -------------------------------------------------
+
+
+def test_live_watchdog_end_to_end(tmp_path):
+    """Real watchdog thread: a busy participant goes silent, the
+    watchdog flags it within its check interval, then sees recovery."""
+    trace.configure(enabled=True, dump_dir=str(tmp_path),
+                    dump_cooldown_s=0)
+    HL.configure(check_interval_s=0.05)
+    hb = HL.register("slow", max_age_s=0.1)
+    deadline = time.monotonic() + 5.0
+    while HL.EVENTS["stale"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert HL.EVENTS["stale"] == 1
+    hb.beat()
+    deadline = time.monotonic() + 5.0
+    while HL.EVENTS["recovered"] < 1 and time.monotonic() < deadline:
+        hb.beat()
+        time.sleep(0.02)
+    assert HL.EVENTS["recovered"] == 1
+    assert any(
+        json.load(open(p))["kind"] == "watchdog.slow"
+        for p in trace.dumps()
+    )

@@ -67,6 +67,19 @@
 #      end, invalid-proposal + malformed-wire sprayer throttled and
 #      muted) via chaos_sweep --quick --check; byz_* metrics land as
 #      an ephemeral BENCH round gated by bench_ledger --check.
+#  10. overload survival — the robustness-past-rated-capacity tier
+#      (ISSUE 14): the health-watchdog / resource-governor /
+#      rate-limiter unit tiers, then tools/soak.py --quick --check
+#      (resource-STATIONARITY regression slopes on RSS / fds /
+#      threads / queue depth under sustained mixed load), then the
+#      overload_storm (10x rated ingress against a governed
+#      localnet: tiers engage, work is rejected-not-crashed,
+#      consensus never sheds, resources bounded) and
+#      wedged_thread_recovery (flush thread killed + sidecar reader
+#      stalled mid-round; watchdog detects, dumps, restarts,
+#      recovers) scenarios via chaos_sweep; soak_* + overload
+#      metrics land as an ephemeral BENCH round gated by
+#      bench_ledger --check.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -117,7 +130,8 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
 CHAOS_ROUND="$(mktemp)"
 CRASH_ROUND="$(mktemp)"
 BYZ_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND"' EXIT
+SOAK_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --scenario view_change_storm --scenario epoch_election_rotation \
   --scenario cross_shard_partition --scenario validator_churn \
@@ -153,5 +167,20 @@ JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --bench-out "$BYZ_ROUND" --bench-round 997 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$BYZ_ROUND" > /dev/null
+
+echo "== overload survival: watchdog/governor tiers + soak + overload scenarios =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_health.py \
+  tests/test_governor.py \
+  tests/test_ratelimit.py
+JAX_PLATFORMS=cpu python tools/soak.py --quick --check \
+  --bench-out "$SOAK_ROUND" --bench-round 996 > /dev/null
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario overload_storm --scenario wedged_thread_recovery \
+  --bench-base "$SOAK_ROUND" --bench-out "$SOAK_ROUND" \
+  --bench-round 996 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$SOAK_ROUND" > /dev/null
 
 echo "check.sh: OK"
