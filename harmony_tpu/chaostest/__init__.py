@@ -4,15 +4,17 @@ Public surface:
 
 * :mod:`.scenario` — the declarative vocabulary (Topology, Traffic,
   Phase, Invariants, Scenario);
-* :mod:`.scenarios` — the named roadmap scenarios (five composed
-  fault scenarios + two durable kill/restart scenarios) +
-  ``SCENARIOS`` registry;
+* :mod:`.scenarios` — the named roadmap scenarios (composed fault
+  scenarios, durable kill/restart, byzantine adversaries, overload
+  survival, WAN/gray-failure netem) + ``SCENARIOS`` registry;
 * :mod:`.runner` — ``run(scenario) -> ScenarioResult``;
+* :mod:`.netem` — seed-deterministic per-directed-link conditioning
+  (latency/jitter/loss/dup/reorder/bandwidth) for both transports;
 * :mod:`.fixtures` — deterministic builders shared with the unit
-  tiers (election fixtures, flood shapes).
+  tiers (election fixtures, flood shapes, the mainnet roster).
 
-Driven by ``tools/chaos_sweep.py`` (check.sh stages 7-8); the scenario ×
-fault × invariant matrix is documented in docs/ANALYSIS.md.
+Driven by ``tools/chaos_sweep.py`` (check.sh stages 7-11); the
+scenario × fault × invariant matrix is documented in docs/ANALYSIS.md.
 """
 
 from .runner import RunEnv, ScenarioResult, run

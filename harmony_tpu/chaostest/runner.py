@@ -146,7 +146,13 @@ def _build(scenario: Scenario, registry, built: list | None = None
     from . import fixtures as FX
 
     top = scenario.topology
-    spans = [2 if i < top.multikey else 1 for i in range(top.nodes)]
+    if top.committee_size:
+        # mainnet-shape committee: distribute the slots round-robin
+        # across the nodes (64 over 4 = 16 keys/node)
+        base, rem = divmod(top.committee_size, top.nodes)
+        spans = [base + (1 if i < rem else 0) for i in range(top.nodes)]
+    else:
+        spans = [2 if i < top.multikey else 1 for i in range(top.nodes)]
     n_keys = sum(spans)
     # the overload flood needs enough FUNDED senders to genuinely fill
     # a pool (per-sender slots bound what one account can hold): widen
@@ -173,6 +179,12 @@ def _build(scenario: Scenario, registry, built: list | None = None
         scenario=scenario, net=InProcessNetwork(), handles=[],
         registry=registry, ecdsa_keys=ecdsa_keys, ext_keys=ext_keys,
     )
+    # every run carries a link conditioner seeded from the scenario:
+    # disarmed (no rules) it costs one attribute check per delivery;
+    # Phase.partition / Phase.links install rules through it
+    from .netem import NetEm
+
+    env.net.netem = NetEm(seed=scenario.seed)
     if built is not None:
         # expose the env to the caller BEFORE any resource (server
         # socket, sidecar dial) is opened: a build that raises partway
@@ -632,6 +644,92 @@ def _resolve_partition(env: RunEnv, spec: str) -> list:
     return [spec]
 
 
+def _resolve_endpoint(env: RunEnv, spec: str) -> list:
+    """A netem link endpoint: ``"*"`` stays a wildcard; anything else
+    goes through the partition grammar (literal name, ``"leader"``,
+    ``"round_leader[:shard]"``)."""
+    if spec == "*":
+        return ["*"]
+    return _resolve_partition(env, spec)
+
+
+def _phase_rules(env: RunEnv, phase) -> tuple:
+    """Resolve one phase's fault topology into concrete netem rules:
+    ``partition`` names become total-loss rules in both directions
+    (the old binary black-hole as a loss=1.0 special case), ``links``
+    specs resolve their src/dst endpoints at trigger time.  Returns
+    (rules, isolated_names) — the latter feed cut_sync/measure_heal."""
+    from dataclasses import replace
+
+    from . import netem as NE
+
+    tag = f"phase:{phase.name}"
+    names: list = []
+    for spec in phase.partition:
+        names.extend(_resolve_partition(env, spec))
+    rules: list = []
+    for nm in names:
+        rules.extend(NE.partition_rules(nm, tag=tag))
+    for spec in phase.links:
+        base = NE.parse_link(spec, tag=tag)
+        for src in _resolve_endpoint(env, base.src):
+            for dst in _resolve_endpoint(env, base.dst):
+                if src == dst and src != "*":
+                    continue  # a host's self-link is never conditioned
+                rules.append(replace(base, src=src, dst=dst))
+    return rules, names
+
+
+def _cut_sync(env: RunEnv, handle) -> None:
+    """Sever one node's sync pull for a phase window: a gossip
+    partition alone leaves the TCP sync mesh reachable, so a 'fully
+    isolated' node would quietly keep up through it.  The in-flight
+    downloader (if a spin-up holds it) is starved of clients, the
+    registry slot is emptied (no new spin-up), and the clients are
+    closed; ``wire_sync`` at heal rebuilds all of it."""
+    dl = handle._registry.get("downloader")
+    if dl is not None:
+        dl.clients = []
+    for c in handle.sync_clients:
+        try:
+            c.close()
+        except OSError:
+            pass
+    handle.sync_clients = []
+    handle._registry.set("downloader", None)
+
+
+def _heal_phase(env: RunEnv, phase, names, by_name, heal_watch) -> None:
+    """Close one fault window: remove its netem rules, stamp the heal
+    head, rewire severed sync, and — for ``measure_heal`` phases —
+    record each isolated node's blocks-behind lag and start its
+    heal-to-caught-up timer."""
+    netem = getattr(env.net, "netem", None)
+    if netem is not None:
+        netem.remove_tag(f"phase:{phase.name}")
+    else:  # legacy binary transport (netem-less nets in unit stubs)
+        for nm in names:
+            env.net.partitioned.discard(nm)
+    # NOTE: window stamps read shard 0 (every current netem scenario
+    # is single-shard); a multi-shard gray-failure scenario's custom
+    # invariant should read its target shard's chains directly
+    ph = env.data.get("phase_heads", {}).get(phase.name)
+    if ph is not None:
+        ph[1] = env.shard_head(0)
+    for nm in names:
+        h = by_name.get(nm)
+        if h is None or h.node is None:
+            continue
+        if phase.measure_heal:
+            lag = env.shard_head(h.shard) - h.chain.head_number
+            env.data["heal_lag"] = max(
+                env.data.get("heal_lag", 0), lag
+            )
+            heal_watch.append({"h": h, "at": time.monotonic()})
+        if phase.cut_sync:
+            env.data["wire_sync"](h)
+
+
 def _timeline(env: RunEnv, stop, t0: float, phases_done):
     """Execute the scenario's fault script: trigger each phase on its
     round/time condition, arm its faultinject rules with the window's
@@ -642,15 +740,30 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
     # kill tasks: {"h", "kill", "state", "deadline"/"restart_at"}
     # armed -> down -> recovering -> done
     kills: list = []
+    # heal watches (measure_heal): {"h", "at"} — healed-isolate
+    # catch-up timers, resolved when the node reaches the shard head
+    heal_watch: list = []
     by_name = {h.name: h for h in env.handles}
 
     def kill_open(t):
         return t["state"] in ("armed", "down", "recovering")
 
     try:
-        while not stop.is_set() and (
-            pending or active or any(kill_open(t) for t in kills)
-        ):
+        while not stop.is_set():
+            finite = bool(
+                pending or heal_watch
+                or any(kill_open(t) for t in kills)
+                or any(end is not None for _, end, _, _ in active)
+            )
+            if not finite:
+                # only whole-run windows (duration None, e.g. a WAN
+                # matrix) remain: the SCRIPT is done — signal it so
+                # the run can complete at its floors — but keep the
+                # rules armed until scenario end (healing them now
+                # would strip the conditioning the scenario is about)
+                phases_done.set()
+                if not active:
+                    break
             now = time.monotonic()
             now_s = now - t0
             head = env.shard_head(0)
@@ -663,11 +776,28 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                 if not hit:
                     continue
                 pending.remove(phase)
-                names = []
-                for spec in phase.partition:
-                    names.extend(_resolve_partition(env, spec))
-                for nm in names:
-                    env.net.partitioned.add(nm)
+                # partition + degraded links both install as netem
+                # rules (partition = loss 1.0 both ways), healed by
+                # tag when the window closes; a netem-less net (unit
+                # stubs) falls back to the binary partitioned set
+                rules, names = _phase_rules(env, phase)
+                netem = getattr(env.net, "netem", None)
+                if netem is not None:
+                    if rules:
+                        netem.add(*rules)
+                elif names:
+                    for nm in names:
+                        env.net.partitioned.add(nm)
+                if phase.cut_sync:
+                    for nm in names:
+                        h = by_name.get(nm)
+                        if h is not None and h.node is not None:
+                            _cut_sync(env, h)
+                # head stamps: custom invariants judge what the chain
+                # did DURING the window (no-wedge, heal lag)
+                env.data.setdefault("phase_heads", {})[phase.name] = [
+                    head, None,
+                ]
                 for arm_kw in phase.arms:
                     kw = dict(arm_kw)
                     if phase.duration_s is not None:
@@ -702,7 +832,8 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                     "chaos phase armed", phase=phase.name,
                     at_round=head, t_s=round(now_s, 2),
                     partitioned=",".join(names) or "-",
-                    arms=len(phase.arms), kills=len(phase.kills),
+                    link_rules=len(rules), arms=len(phase.arms),
+                    kills=len(phase.kills), cut_sync=phase.cut_sync,
                 )
             for entry in active[:]:
                 phase, end, names, cap = entry
@@ -719,8 +850,7 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                         done = True  # a broken predicate must not wedge
                     if not done:
                         continue
-                for nm in names:
-                    env.net.partitioned.discard(nm)
+                _heal_phase(env, phase, names, by_name, heal_watch)
                 active.remove(entry)
                 _log.warn("chaos phase healed", phase=phase.name)
             for task in kills:
@@ -761,15 +891,33 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                                 time.monotonic() - h.killed_at, 2
                             ),
                         )
+            for w in heal_watch[:]:
+                # measure_heal: the healed isolate has caught back up
+                # to the live network head
+                h = w["h"]
+                if h.chain.head_number >= env.shard_head(h.shard):
+                    catchup = time.monotonic() - w["at"]
+                    env.data.setdefault(
+                        "heal_catchup_s", []
+                    ).append(catchup)
+                    heal_watch.remove(w)
+                    _log.warn(
+                        "chaos healed node caught up", node=h.name,
+                        head=h.chain.head_number,
+                        heal_catchup_s=round(catchup, 2),
+                    )
             time.sleep(0.05)
     finally:
-        # scenario end or abort: heal every partition we created
-        # (armed rules expire by their own t1 windows); a node still
-        # DOWN with a pending restart is restarted so teardown and
-        # invariants see the recovered shape, not a half-run script
-        for _, _, names, _ in active:
-            for nm in names:
-                env.net.partitioned.discard(nm)
+        # scenario end or abort: heal every link rule we installed
+        # (armed rules expire by their own t1 windows) and rewire any
+        # severed sync; a node still DOWN with a pending restart is
+        # restarted so teardown and invariants see the recovered
+        # shape, not a half-run script
+        for phase, _, names, _ in active:
+            try:
+                _heal_phase(env, phase, names, by_name, heal_watch)
+            except Exception as e:  # noqa: BLE001
+                env.errors.append(f"heal {phase.name}: {e!r}")
         for task in kills:
             if task["state"] == "down" and not stop.is_set():
                 try:
@@ -1078,8 +1226,14 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
                 # restarted nodes run on a fresh pump thread
                 if h.pump is not None and h.pump not in pumps:
                     h.pump.join(timeout=10)
-            # heal any leftover partition before invariant checks
+            # heal any leftover partition before invariant checks;
+            # the conditioner's scheduler goes down WITH the net (a
+            # daemon thread parked in a wait at interpreter exit is
+            # the abort vector sched.reset() guards)
             env.net.partitioned.clear()
+            if env.net.netem is not None:
+                env.net.netem.clear()
+                env.net.netem.close()
             for h in env.handles:
                 for c in h.sync_clients:
                     try:
@@ -1194,6 +1348,22 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         "run_s": _m(round(run_s, 2), "s",
                     window_s=scenario.window_s),
     }
+    netem = env.net.netem
+    if netem is not None and netem.ever_armed:
+        tot = netem.totals()
+        for event in ("delayed", "dropped", "duplicated", "reordered"):
+            metrics[f"netem_{event}"] = _m(
+                tot.get(event, 0), "messages"
+            )
+    heal = env.data.get("heal_catchup_s")
+    if heal:
+        metrics["heal_catchup_seconds"] = _m(
+            round(max(heal), 3), "s", heals=len(heal),
+            derived_from="heal_to_caught_up",
+        )
+        metrics["heal_lag_blocks"] = _m(
+            env.data.get("heal_lag", 0), "blocks",
+        )
     # scenario-specific measured extras (the byzantine scenarios stash
     # their evidence-pipeline numbers here from custom invariants)
     for name, entry in (env.data.get("extra_metrics") or {}).items():

@@ -42,6 +42,11 @@ class Topology:
     nodes: int = 4             # validators per shard
     shards: int = 1
     multikey: int = 0          # first M nodes hold TWO committee keys
+    # mainnet-shape committees (ISSUE 15): a non-zero committee_size
+    # distributes that many committee keys round-robin across the
+    # nodes (64 over 4 nodes = 16 keys/node — pushing toward the
+    # reference's 200 slots/shard); overrides ``multikey``
+    committee_size: int = 0
     blocks_per_epoch: int = 16
     staking: bool = False      # wire a Finalizer: real EPoS elections
     external_validators: int = 0  # staked external keys; key i rides
@@ -126,7 +131,23 @@ class Phase:
     True (the fault has provably done its job, e.g. a NEWVIEW
     adopted), capped at ``hold_max_s`` after trigger so a scenario
     whose fault genuinely never bites still heals and fails its
-    invariant instead of wedging the run."""
+    invariant instead of wedging the run.
+
+    ``links`` (ISSUE 15) are netem link-rule specs
+    (:func:`..netem.parse_link` dict or string grammar) installed for
+    the window and healed with it — per-DIRECTED-link latency /
+    jitter / loss / duplication / reorder / bandwidth, with ``src`` /
+    ``dst`` accepting the partition grammar (``"leader"``,
+    ``"round_leader[:shard]"``, ``"*"``).  ``partition`` is now sugar
+    for the special case ``loss=1.0`` in both directions.
+    ``cut_sync`` additionally severs the partitioned/linked nodes'
+    sync downloaders for the window (gossip partition alone leaves
+    the TCP sync mesh reachable — a FULLY isolated node must not be
+    able to quietly keep up through it); they are rewired at heal.
+    ``measure_heal`` records, for each node the phase fully isolated,
+    its blocks-behind lag at heal time (``env.data["heal_lag"]``) and
+    the heal-to-caught-up seconds (``env.data["heal_catchup_s"]``,
+    surfaced as the ``heal_catchup_seconds`` scenario metric)."""
 
     name: str
     at_round: int | None = None
@@ -134,6 +155,9 @@ class Phase:
     duration_s: float | None = None
     arms: tuple = ()
     partition: tuple = ()
+    links: tuple = ()  # netem link-rule specs, healed with the window
+    cut_sync: bool = False
+    measure_heal: bool = False
     kills: tuple = ()  # Kill specs executed at trigger time
     hold_until: object = None    # fn(env) -> bool, checked after duration_s
     hold_max_s: float = 30.0     # hard cap on a held window, from trigger

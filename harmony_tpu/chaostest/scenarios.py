@@ -651,6 +651,323 @@ def byz_invalid_proposal_flood(quick: bool = False) -> Scenario:
     )
 
 
+# -- WAN / gray-failure scenarios (ISSUE 15): netem-conditioned links --------
+
+
+def _handle_named(env, name: str):
+    return next(h for h in env.handles if h.name == name)
+
+
+def _adoptions(env):
+    return sum(
+        h.node.new_views_adopted
+        for h in env.handles if h.node is not None
+    )
+
+
+def _no_wedge(env):
+    """Gray leader: the committee must make progress THROUGH the
+    degraded window — blocks committed while the rules were live, or a
+    NEWVIEW routed around the gray leader.  A window that produced
+    neither is the wedge this scenario exists to catch (a
+    slow-but-not-dead leader is invisible to every binary fault)."""
+    ph = env.data.get("phase_heads", {}).get("gray-leader")
+    if ph is None:
+        return False, "the gray-leader phase never armed"
+    if ph[1] is None:
+        return False, "the gray-leader phase never healed"
+    committed = ph[1] - ph[0]
+    adoptions = _adoptions(env)
+    if committed < 1 and adoptions < 1:
+        return False, (
+            "WEDGE: zero blocks committed and zero NEWVIEW adoptions "
+            "across the degraded window"
+        )
+    tot = env.net.netem.totals()
+    if tot.get("delayed", 0) < 10:
+        return False, (
+            f"only {tot.get('delayed', 0)} messages conditioned — the "
+            "gray links never engaged"
+        )
+    env.data["extra_metrics"] = {
+        "gray_window_blocks": _m(committed, "blocks"),
+        "gray_window_adoptions": _m(adoptions, "adoptions"),
+    }
+    return True, ""
+
+
+def gray_leader(quick: bool = False) -> Scenario:
+    """The canonical gray failure: the round leader's links (BOTH
+    directions) degraded to 300 ms base latency + jitter + 5 % loss —
+    slow-but-not-dead, the failure mode no binary partition can
+    express.  Rounds must either commit within the latency-inflated
+    bound or view-change around the gray leader; never wedge, never
+    fork, never shed consensus work."""
+    return Scenario(
+        name="gray_leader",
+        seed=59,
+        topology=Topology(
+            nodes=4, block_time_s=0.25,
+            phase_timeout_s=2.5 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=100.0 if quick else 300.0,
+            replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        phases=(
+            Phase(
+                "gray-leader", at_round=2,
+                duration_s=8.0 if quick else 16.0,
+                links=(
+                    {"src": "round_leader", "dst": "*",
+                     "delay_ms": 300.0, "jitter_ms": 80.0,
+                     "loss": 0.05},
+                    {"src": "*", "dst": "round_leader",
+                     "delay_ms": 300.0, "jitter_ms": 80.0,
+                     "loss": 0.05},
+                ),
+            ),
+        ),
+        # p99 is gray-shaped: a round spanning the degraded window
+        # carries 2-3 conditioned RTTs plus possible VC ladder steps —
+        # the SHARP assertions are no_wedge + liveness + no fork
+        invariants=Invariants(
+            min_blocks=5 if quick else 9,
+            round_p99_s=60.0,
+            custom=(("no_wedge", _no_wedge),),
+        ),
+        window_s=120.0 if quick else 240.0,
+    )
+
+
+def _asymmetric_defended(env):
+    """Half-duplex leader: inbound traffic to the leader was actually
+    dropped, and the committee assembled a NEWVIEW WITHOUT the
+    leader's cooperation (its VC vote and its collector are both
+    unreachable — the quorum must form among the others)."""
+    ph = env.data.get("phase_heads", {}).get("deaf-leader")
+    if ph is None:
+        return False, "the deaf-leader phase never armed"
+    if ph[1] is None:
+        return False, "the deaf-leader phase never healed"
+    tot = env.net.netem.totals()
+    if tot.get("dropped", 0) < 1:
+        return False, "no inbound message was ever dropped"
+    adoptions = _adoptions(env)
+    if adoptions < 1:
+        return False, (
+            "no NEWVIEW assembled without the deaf leader's "
+            "cooperation"
+        )
+    env.data["extra_metrics"] = {
+        "asym_inbound_dropped": _m(tot["dropped"], "messages"),
+        "asym_adoptions": _m(adoptions, "adoptions"),
+    }
+    return True, ""
+
+
+def asymmetric_partition(quick: bool = False) -> Scenario:
+    """The classic half-duplex failure: the round leader SENDS fine
+    but cannot RECEIVE (every link INTO it is total loss; its outbound
+    links are untouched).  Validators get the ANNOUNCE, send votes the
+    leader never hears, time out, and must assemble a NEWVIEW without
+    the leader's cooperation — then the healed leader resyncs and
+    rejoins.  Asymmetric rules are first-class: A->B and B->A
+    condition independently."""
+    return Scenario(
+        name="asymmetric_partition",
+        seed=61,
+        topology=Topology(
+            nodes=4, block_time_s=0.2,
+            phase_timeout_s=2.0 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=100.0 if quick else 300.0,
+            replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        phases=(
+            Phase(
+                "deaf-leader", at_round=2,
+                duration_s=6.0 if quick else 12.0,
+                links=(
+                    {"src": "*", "dst": "round_leader", "loss": 1.0},
+                ),
+                # load-relative close (the storm's rationale): healing
+                # before the VC ladder completes hands the round back
+                # to the once-deaf leader with zero adoptions
+                hold_until=lambda env: _adoptions(env) >= 1,
+                hold_max_s=45.0 if quick else 60.0,
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=4 if quick else 8,
+            round_p99_s=60.0,
+            min_view_changes=1,
+            custom=(
+                ("asymmetric_defended", _asymmetric_defended),
+            ),
+        ),
+        window_s=150.0 if quick else 240.0,
+    )
+
+
+def _minority_healed(env):
+    """Partition heal: the isolated validator must have genuinely
+    fallen >= 8 blocks behind (full isolation: gossip AND sync both
+    cut) and, once healed, caught back up to the live head through
+    the staged sync path while the chain kept advancing — measured as
+    ``heal_catchup_seconds`` (the runner's heal watch)."""
+    lag = env.data.get("heal_lag", 0)
+    if lag < 8:
+        return False, (
+            f"isolated node was only {lag} blocks behind at heal "
+            "(need >= 8: the partition never genuinely isolated it)"
+        )
+    heals = env.data.get("heal_catchup_s") or []
+    if not heals:
+        return False, "the healed node never caught back up"
+    synced = _handle_named(env, "s0n3").node.sync_spinups
+    if synced < 1:
+        return False, (
+            "the healed node never span up its downloader — it did "
+            "not catch up through the sync path"
+        )
+    return True, ""
+
+
+def minority_partition_heal(quick: bool = False) -> Scenario:
+    """One validator FULLY cut off under load — gossip black-holed
+    via loss=1.0 link rules AND its sync downloader severed (gossip
+    partition alone leaves the TCP sync mesh reachable, so the
+    'isolated' node would quietly keep up) — until it is >= 8 blocks
+    behind, then healed.  The committee keeps committing throughout;
+    the healed node must catch up through sync/staged.py within a
+    measured ``heal_catchup_seconds`` bound with zero divergent
+    heads.  The isolate is the SINGLE-slot node of a 7-key committee
+    (committee_size=7 over 4 nodes: spans 2/2/2/1): 6 live keys
+    against a quorum bar of 5 (2n/3+1) leaves ONE key of slack, so a
+    straggling vote cannot wedge the survivors — the first two cuts
+    of this scenario ran the live committee at the EXACT quorum edge
+    (3-of-4 and 5-of-6) and a single de-synced validator wedged
+    block production for most of the hold window, so the >= 8-block
+    lag never accumulated.  A long member outage needs quorum slack;
+    the exact-edge shapes belong to the churn/byzantine scenarios
+    whose fault windows are adoption-relative, not lag-relative."""
+    return Scenario(
+        name="minority_partition_heal",
+        seed=67,
+        topology=Topology(
+            nodes=4, committee_size=7, block_time_s=0.2,
+            phase_timeout_s=2.0 if quick else 4.0,
+        ),
+        traffic=Traffic(
+            plain_rate=120.0 if quick else 400.0,
+            replay_workers=1,
+            flood_duration_s=5.0 if quick else 10.0,
+        ),
+        phases=(
+            Phase(
+                "isolate-s0n3", at_round=2,
+                duration_s=5.0 if quick else 10.0,
+                partition=("s0n3",),
+                cut_sync=True,
+                measure_heal=True,
+                # the window is LAG-relative, not wall-clock: it holds
+                # until the isolate is genuinely >= 8 blocks behind the
+                # committee (a loaded box commits slower, and healing
+                # at a 3-block lag would test nothing)
+                hold_until=lambda env: (
+                    env.shard_head(0)
+                    - _handle_named(env, "s0n3").chain.head_number
+                    >= 8
+                ),
+                hold_max_s=100.0 if quick else 150.0,
+            ),
+        ),
+        # p99 is wedge-ladder-shaped: the isolate's leader views run
+        # the escalating VC ladder by design — SHARP assertions are
+        # the heal arc (lag >= 8, catch-up via sync, measured
+        # catch-up seconds), liveness and no_divergent_heads
+        invariants=Invariants(
+            min_blocks=10 if quick else 14,
+            round_p99_s=90.0,
+            custom=(("minority_healed", _minority_healed),),
+        ),
+        window_s=220.0 if quick else 360.0,
+    )
+
+
+def _wan_committee_live(env):
+    """The mainnet-shape acceptance: the LIVE committee must carry
+    >= 64 slots (the largest this repo has ever run), the WAN matrix
+    must have actually conditioned traffic, and every node must hold
+    its share of the multi-key slots."""
+    chain = env.honest(0)[0].chain
+    epoch = chain.epoch_of(chain.head_number)
+    slots = len(chain.committee_for_epoch(epoch))
+    if slots < 64:
+        return False, f"live committee carries {slots} slots (< 64)"
+    per_node = [
+        len(h.node.keys) for h in env.honest(0) if h.node is not None
+    ]
+    if min(per_node) < 64 // len(env.honest(0)):
+        return False, f"unbalanced multi-key spans {per_node}"
+    tot = env.net.netem.totals()
+    if tot.get("delayed", 0) < 50:
+        return False, (
+            f"only {tot.get('delayed', 0)} messages rode the WAN "
+            "matrix — the conditioner never engaged"
+        )
+    env.data["extra_metrics"] = {
+        "wan_committee_slots": _m(slots, "slots"),
+        "wan_delayed_messages": _m(tot["delayed"], "messages"),
+    }
+    return True, ""
+
+
+def wan_committee(quick: bool = False) -> Scenario:
+    """The first mainnet-shaped chaos run: a 4-node localnet whose
+    nodes are 16-key operators carrying a 64-slot committee (pushing
+    toward the reference's 200 slots/shard) under a WAN latency
+    matrix — every directed pair draws a stable RTT from 50–150 ms
+    (seed-keyed), 10 ms jitter, 0.5 % loss.  Liveness, round p99 and
+    zero consensus-lane sheds must hold with every quorum proof
+    aggregating 64 slots over conditioned links; the round p99 lands
+    in the BENCH ledger as the WAN-committee yardstick
+    (arXiv:2302.00418: committee consensus latency is dominated by
+    exactly this matrix)."""
+    return Scenario(
+        name="wan_committee",
+        seed=71,
+        topology=Topology(
+            nodes=4, committee_size=64, block_time_s=0.5,
+            phase_timeout_s=8.0 if quick else 12.0,
+        ),
+        traffic=Traffic(
+            plain_rate=60.0 if quick else 200.0,
+            pop_rate=4.0, replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        phases=(
+            Phase(
+                "wan-matrix", at_s=0.0, duration_s=None,
+                # the whole run rides the matrix (duration None =
+                # until scenario end); the string grammar is the
+                # operator-facing spec, exercised here on purpose
+                links=("*->* rtt=50..150ms jitter=10ms loss=0.5%",),
+            ),
+        ),
+        invariants=Invariants(
+            min_blocks=3 if quick else 6,
+            round_p99_s=45.0,
+            custom=(("wan_committee_live", _wan_committee_live),),
+        ),
+        window_s=150.0 if quick else 280.0,
+    )
+
+
 # -- overload scenarios (ISSUE 14): past rated capacity ----------------------
 
 
@@ -901,4 +1218,8 @@ SCENARIOS = {
     "byz_invalid_proposal_flood": byz_invalid_proposal_flood,
     "overload_storm": overload_storm,
     "wedged_thread_recovery": wedged_thread_recovery,
+    "gray_leader": gray_leader,
+    "asymmetric_partition": asymmetric_partition,
+    "minority_partition_heal": minority_partition_heal,
+    "wan_committee": wan_committee,
 }

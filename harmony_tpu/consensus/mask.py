@@ -116,9 +116,21 @@ class Mask:
         """The masked aggregate public key, as a reference affine point.
 
         device=True runs the batched TPU tree-sum; False uses host
-        bigints (both bitwise-identical, tested).
-        """
-        if not device or len(self.publics) == 0:
+        bigints (both bitwise-identical, tested).  Twin mode
+        (``device.kernel_twin_active``) forces the host path even when
+        a caller asks for the device: twins keep jax UNLOADED by
+        contract, and this is the one device call reachable OUTSIDE
+        device.py's guarded dispatch — the NEWVIEW verify path used to
+        compile a fresh XLA masked-sum ON THE CONSENSUS PUMP THREAD
+        the first time a committee width appeared, wedging every
+        validator's pump for the length of an XLA:CPU compile
+        (~90 s at width 7; found by the minority_partition_heal chaos
+        scenario, whose view changes are the first to exercise NEWVIEW
+        adoption at unusual committee widths)."""
+        from .. import device as DV
+
+        if (not device or DV.kernel_twin_active()
+                or len(self.publics) == 0):
             # native Jacobian sum when available, affine bigint otherwise
             return RB.aggregate_pubkeys(self.get_signed_pubkeys())
         import jax.numpy as jnp

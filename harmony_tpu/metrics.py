@@ -270,6 +270,9 @@ class Registry:
         lines.append(self._sched_counters())
         lines.append(self._p2p_counters())
         lines.append(self._slash_counters())
+        netem = self._netem_counters()
+        if netem:
+            lines.append(netem)
         lines.append(self._process_gauges())
         lines.append(self._health_metrics())
         lines.append(self._governor_metrics())
@@ -307,6 +310,18 @@ class Registry:
                        f"# TYPE {name} gauge\n"
                        f"{name} {v}")
         return "\n".join(out)
+
+    @staticmethod
+    def _netem_counters() -> str:
+        """Link-conditioning families (chaostest.netem singletons) —
+        only when the netem module was ever imported: production
+        exposition must not pull the chaos framework in."""
+        import sys
+
+        mod = sys.modules.get("harmony_tpu.chaostest.netem")
+        if mod is None:
+            return ""
+        return mod.expose()
 
     @staticmethod
     def _health_metrics() -> str:

@@ -241,6 +241,11 @@ class InProcessNetwork:
         self.scores: dict[str, float] = {}
         self._throttle_ctr: dict[str, int] = {}
         self.invalid_total = 0         # REJECT verdicts observed
+        # optional per-directed-link conditioner
+        # (chaostest.netem.NetEm): latency/jitter/loss/dup/reorder/
+        # rate per (from, to) host pair — None costs one attribute
+        # check on the delivery path
+        self.netem = None
 
     def host(self, name: str) -> "_InProcessHost":
         h = _InProcessHost(name, self)
@@ -282,17 +287,43 @@ class InProcessNetwork:
         # dead on arrival for ~50 s until cache eviction.  libp2p ids
         # are (sender, seqno): every publish is a fresh message —
         # TCPHost stamps the same semantics into its PUBLISH bodies.
-        rejects = 0
+        nm = self.netem
+        if nm is not None and not nm.armed:
+            nm = None  # disarmed conditioner: skip closures entirely
         for h in hosts:
             if h.name == frm or h.name in self.partitioned:
                 continue
-            verdict = h._validate(topic, payload, frm)
-            if verdict == ACCEPT:
-                h._deliver(topic, payload, frm)
-            elif verdict == REJECT:
-                rejects += 1
-        if rejects:
-            self._punish(frm, rejects)
+            if nm is not None and nm.send(
+                frm, h.name, len(payload),
+                lambda h=h: self._deliver_one(
+                    topic, payload, frm, h, recheck=True
+                ),
+            ):
+                continue  # conditioned: dropped or scheduled
+            self._deliver_one(topic, payload, frm, h)
+
+    def _deliver_one(self, topic: str, payload: bytes, frm: str, h,
+                     recheck: bool = False):
+        """Validate + deliver to ONE host — the hub's per-link
+        delivery chokepoint.  ``recheck`` is set by netem-DELAYED
+        deliveries only: a message that spent time in flight must
+        re-check partition state and host liveness (its destination
+        may have been partitioned or killed meanwhile); the inline
+        path already checked all of that in ``route`` and keeps its
+        lock-free cost."""
+        if recheck:
+            if frm in self.partitioned or h.name in self.partitioned:
+                return
+            with self._lock:
+                if frm in self.muted or not any(
+                    x is h for x in self._hosts
+                ):
+                    return
+        verdict = h._validate(topic, payload, frm)
+        if verdict == ACCEPT:
+            h._deliver(topic, payload, frm)
+        elif verdict == REJECT:
+            self._punish(frm, 1)
 
     def _punish(self, frm: str, rejects: int):
         """Score a sender down for REJECT verdicts (malformed/bogus
@@ -398,6 +429,9 @@ class TCPHost(Host):
         self._graft_backoff: dict[tuple, float] = {}  # (sockid,topic)->t
         self._mcache = _MsgCache()
         self._seen = _SeenCache()  # flood-dedup: TCP re-floods multipath
+        # optional per-directed-link conditioner on the publish path
+        # (chaostest.netem.NetEm), keyed (self.name -> peer HELLO name)
+        self.netem = None
         # per-publish id salt+counter (stamped into PUBLISH bodies by
         # _pack_publish; salt makes ids unique ACROSS hosts publishing
         # identical payloads)
@@ -792,14 +826,39 @@ class TCPHost(Host):
             return list(mesh)
 
     def _mesh_push(self, topic: str, body: bytes, exclude=None):
-        for s in self._mesh_peers(topic):
+        """The TCPHost publish path — netem-conditioned per directed
+        (self -> peer) link when a conditioner is installed (publish
+        AND re-flood both funnel through here; IWANT repair serves
+        from the mcache unconditioned, like a retransmit)."""
+        nm = self.netem
+        if nm is not None and not nm.armed:
+            nm = None  # disarmed conditioner: skip closures entirely
+        peers = self._mesh_peers(topic)
+        names = {}
+        if nm is not None:
+            with self._peer_lock:
+                # a mesh peer whose HELLO name is somehow unknown
+                # (drop racing this snapshot) conditions as "?": a
+                # wildcard rule — a total partition — still applies;
+                # only name-specific rules need the identity
+                names = {id(s): self._peers.get(s) or "?"
+                         for s in peers}
+        for s in peers:
             if s is exclude:
                 continue
-            try:
-                self._send_frame(s, _KIND_PUBLISH, body)
-                self.sent_publish_frames += 1
-            except OSError:
-                pass
+            if nm is not None and nm.send(
+                self.name, names.get(id(s), "?"), len(body),
+                lambda s=s: self._send_publish(s, body),
+            ):
+                continue  # conditioned: dropped or scheduled
+            self._send_publish(s, body)
+
+    def _send_publish(self, s, body: bytes):
+        try:
+            self._send_frame(s, _KIND_PUBLISH, body)
+            self.sent_publish_frames += 1
+        except OSError:
+            pass
 
     def _on_graft(self, sock, topic: str):
         with self._peer_lock:

@@ -13,6 +13,7 @@ BASELINE config #5; the reference verifies block-by-block through cgo).
 
 from __future__ import annotations
 
+import time
 from collections import Counter
 from dataclasses import dataclass, field
 
@@ -54,6 +55,7 @@ class Downloader:
         self.verify_seals = verify_seals
         self.request_deadline_s = request_deadline_s
         self._excluded: set = set()  # id(client), reset per pass
+        self._lat: dict[int, float] = {}  # id(client) -> EWMA seconds
 
     def _deadline(self) -> Deadline | None:
         if self.request_deadline_s is None:
@@ -75,9 +77,36 @@ class Downloader:
         # window for small-batch downloaders
         return min(self.batch, max(8, int(self.batch * scale)))
 
+    _EWMA_ALPHA = 0.3  # smoothing for per-peer response latency
+
+    def _note_latency(self, client, elapsed_s: float) -> None:
+        prev = self._lat.get(id(client))
+        self._lat[id(client)] = (
+            elapsed_s if prev is None
+            else prev + self._EWMA_ALPHA * (elapsed_s - prev)
+        )
+
+    def _call(self, client, fn, *args, **kw):
+        """One peer request, feeding the latency EWMA on success
+        (failures route through ``_exclude`` at the call sites)."""
+        t0 = time.monotonic()
+        out = fn(*args, **kw)
+        self._note_latency(client, time.monotonic() - t0)
+        return out
+
     def _peers(self) -> list:
-        """Healthy peers, in configured order."""
-        return [c for c in self.clients if id(c) not in self._excluded]
+        """Healthy peers, FASTEST FIRST: ordered by EWMA response
+        latency (unmeasured peers sort ahead at 0, in configured
+        order — the sort is stable).  Without the ordering, a
+        drip-feeding peer that answers just under the request deadline
+        every window wins every ``_fetch_window`` race forever — the
+        configured-order scan always reached it first, and 'healthy'
+        was binary.  Exclusion stays per-pass: slow is deprioritized,
+        dead is excluded."""
+        return sorted(
+            (c for c in self.clients if id(c) not in self._excluded),
+            key=lambda c: self._lat.get(id(c), 0.0),
+        )
 
     def _exclude(self, client, stage: str, err) -> None:
         self._excluded.add(id(client))
@@ -95,7 +124,9 @@ class Downloader:
         best = self.chain.head_number
         for c in self._peers():
             try:
-                head, _ = c.get_head(deadline=self._deadline())
+                head, _ = self._call(
+                    c, c.get_head, deadline=self._deadline()
+                )
                 best = max(best, head)
             except (ConnectionError, OSError) as e:
                 self._exclude(c, "heads", e)
@@ -110,8 +141,9 @@ class Downloader:
         votes: list[Counter] = [Counter() for _ in range(count)]
         for c in self._peers():
             try:
-                hashes = c.get_block_hashes(
-                    start, count, deadline=self._deadline()
+                hashes = self._call(
+                    c, c.get_block_hashes, start, count,
+                    deadline=self._deadline(),
                 )
             except (ConnectionError, OSError) as e:
                 self._exclude(c, "hashes", e)
@@ -132,8 +164,9 @@ class Downloader:
         agreed hashes."""
         for c in self._peers():
             try:
-                items = c.get_blocks_by_number(
-                    start, count, deadline=self._deadline()
+                items = self._call(
+                    c, c.get_blocks_by_number, start, count,
+                    deadline=self._deadline(),
                 )
             except (ConnectionError, OSError) as e:
                 self._exclude(c, "bodies", e)
@@ -164,8 +197,9 @@ class Downloader:
             try:
                 start = b""
                 for _ in range(max_pages):
-                    page = c.get_account_range(
-                        num, start, deadline=self._deadline()
+                    page = self._call(
+                        c, c.get_account_range, num, start,
+                        deadline=self._deadline(),
                     )
                     if not page:
                         break
@@ -252,8 +286,9 @@ class Downloader:
         lo = max(head + 1, last_inserted - receipts_tail + 1)
         for c in self._peers():
             try:
-                per_block = c.get_receipts(
-                    lo, last_inserted - lo + 1, deadline=self._deadline()
+                per_block = self._call(
+                    c, c.get_receipts, lo, last_inserted - lo + 1,
+                    deadline=self._deadline(),
                 )
             except (ConnectionError, OSError) as e:
                 self._exclude(c, "receipts", e)

@@ -145,6 +145,8 @@ def test_scenario_registry_names_and_shape():
         "byz_equivocating_leader", "byz_double_voter_slashed",
         "byz_invalid_proposal_flood",
         "overload_storm", "wedged_thread_recovery",
+        "gray_leader", "asymmetric_partition",
+        "minority_partition_heal", "wan_committee",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):

@@ -1,0 +1,438 @@
+"""WAN netem tier (ISSUE 15): link-spec parsing edge cases, seed
+determinism of the delivery schedule, match precedence, rate-cap
+queuing, both transport integrations (in-process hub chokepoint +
+TCPHost publish path), the zero-cost-disarmed claim, the sync
+downloader's EWMA peer ordering, and the vc_timeout ladder pinned
+against a fixed netem delay."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from harmony_tpu.chaostest import netem as NE
+from harmony_tpu.chaostest.netem import Decision, LinkRule, NetEm
+
+
+# -- link-spec parsing -------------------------------------------------------
+
+
+def test_parse_full_string_grammar():
+    r = NE.parse_link(
+        "a->b delay=300ms jitter=50ms loss=5% dup=1% reorder=10% "
+        "rate=1mbps"
+    )
+    assert (r.src, r.dst) == ("a", "b")
+    assert r.delay_ms == 300.0 and r.jitter_ms == 50.0
+    assert r.loss == pytest.approx(0.05)
+    assert r.dup == pytest.approx(0.01)
+    assert r.reorder == pytest.approx(0.10)
+    assert r.rate_bytes_per_s == 1e6
+
+
+def test_parse_units_and_defaults():
+    assert NE.parse_link("a->b delay=1.5s").delay_ms == 1500.0
+    assert NE.parse_link("a->b delay=40").delay_ms == 40.0  # bare = ms
+    assert NE.parse_link("a->b loss=0.25").loss == 0.25
+    assert NE.parse_link("a->b rate=64k").rate_bytes_per_s == 64000.0
+    assert NE.parse_link("a->b rate=512").rate_bytes_per_s == 512.0
+    r = NE.parse_link("a->b")
+    assert r.loss == 0.0 and r.delay_ms == 0.0 and r.dup == 0.0
+
+
+def test_parse_wildcards_and_rtt_range():
+    r = NE.parse_link("*->* rtt=50..150ms jitter=10ms loss=0.5%")
+    assert r.src == "*" and r.dst == "*"
+    assert r.rtt_ms == (50.0, 150.0)
+    assert r.loss == pytest.approx(0.005)
+    # one-sided wildcard via empty endpoint
+    r2 = NE.parse_link("a-> loss=1")
+    assert (r2.src, r2.dst) == ("a", "*") and r2.loss == 1.0
+
+
+def test_parse_dict_spec_and_tagging():
+    r = NE.parse_link(
+        {"src": "x", "dst": "*", "delay_ms": 10, "rtt_ms": [20, 40]},
+        tag="phase:p",
+    )
+    assert r.rtt_ms == (20.0, 40.0) and r.tag == "phase:p"
+
+
+@pytest.mark.parametrize("bad", [
+    "a->b loss=1.5",            # probability above 1
+    "a->b loss=-0.1",           # negative probability
+    "a->b delay=-5ms",          # negative delay
+    "a->b speed=3",             # unknown key
+    "a->b delay",               # bare token, no =
+    "delay=3ms",                # missing src->dst
+    "a->b rtt=50ms",            # rtt without a range
+    "a->b rtt=150..50ms",       # inverted range
+    "a->b rate=fast",           # unparseable rate
+    "a->b delay=xms",           # unparseable duration
+    {"src": "a", "dst": "b", "bogus": 1},  # unknown dict field
+    42,                         # not a spec at all
+])
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        NE.parse_link(bad)
+
+
+def test_partition_rules_are_total_loss_both_ways():
+    rules = NE.partition_rules("s0n2", tag="phase:x")
+    assert len(rules) == 2
+    assert {(r.src, r.dst) for r in rules} == {
+        ("s0n2", "*"), ("*", "s0n2"),
+    }
+    assert all(r.loss == 1.0 and r.tag == "phase:x" for r in rules)
+    nm = NetEm(seed=1)
+    nm.add(*rules)
+    assert nm.decide("s0n2", "s0n1", 10).drop
+    assert nm.decide("s0n1", "s0n2", 10).drop
+    assert nm.decide("s0n0", "s0n1", 10) is None  # third parties clean
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def _script(seed: int):
+    """One scripted event sequence -> its full conditioning schedule
+    (drop set, per-copy delays, duplicate count, reorder flags, and
+    the delivery ORDER by due time)."""
+    nm = NetEm(seed=seed)
+    nm.add({"src": "*", "dst": "*", "delay_ms": 40.0,
+            "jitter_ms": 20.0, "loss": 0.2, "dup": 0.15,
+            "reorder": 0.1})
+    events, order = [], []
+    for i in range(400):
+        src, dst = f"n{i % 4}", f"n{(i + 1 + i // 7) % 4}"
+        d = nm.decide(src, dst, 100 + i)
+        events.append((src, dst, d.drop, d.delays, d.reordered))
+        if not d.drop:
+            for c, dl in enumerate(d.delays):
+                order.append((dl, i, c))
+    order.sort()
+    return repr(events), repr(order)
+
+
+def test_same_seed_identical_delivery_schedule():
+    assert _script(9) == _script(9)
+
+
+def test_different_seed_different_schedule():
+    assert _script(9) != _script(10)
+
+
+def test_schedule_exercises_every_event_class():
+    nm = NetEm(seed=9)
+    nm.add({"src": "*", "dst": "*", "delay_ms": 40.0,
+            "jitter_ms": 20.0, "loss": 0.2, "dup": 0.15,
+            "reorder": 0.1})
+    drops = dups = reorders = 0
+    for i in range(400):
+        d = nm.decide("a", "b", i)
+        drops += d.drop
+        dups += (not d.drop and len(d.delays) == 2)
+        reorders += d.reordered
+    # probabilistic but SEEDED: these are exact, repeatable counts
+    assert drops and dups and reorders
+    assert 0.1 < drops / 400 < 0.3
+
+
+def test_pair_rtt_stable_and_asymmetric():
+    nm = NetEm(seed=3)
+    (rule,) = nm.add("*->* rtt=50..150ms")
+    ab = nm.pair_rtt_ms(rule, "a", "b")
+    assert 50.0 <= ab <= 150.0
+    assert nm.pair_rtt_ms(rule, "a", "b") == ab  # stable per pair
+    # the directed pairs draw independently: A->B and B->A condition
+    # independently (first-class asymmetry)
+    assert nm.pair_rtt_ms(rule, "b", "a") != ab
+    # and the one-way delay is RTT/2
+    d = nm.decide("a", "b", 10)
+    assert d.delays[0] == pytest.approx(ab / 2e3)
+
+
+# -- matching + rate cap -----------------------------------------------------
+
+
+def test_match_most_specific_wins_then_last_installed():
+    nm = NetEm(seed=1)
+    nm.add("*->* delay=10ms")
+    nm.add("a->* delay=20ms")
+    nm.add("*->b delay=30ms")
+    nm.add("a->b delay=40ms")
+    assert nm.decide("a", "b", 1).delays[0] == pytest.approx(0.040)
+    assert nm.decide("a", "c", 1).delays[0] == pytest.approx(0.020)
+    assert nm.decide("c", "b", 1).delays[0] == pytest.approx(0.030)
+    assert nm.decide("c", "d", 1).delays[0] == pytest.approx(0.010)
+    nm.add("a->b delay=50ms")  # same specificity: later wins
+    assert nm.decide("a", "b", 1).delays[0] == pytest.approx(0.050)
+
+
+def test_remove_tag_heals_only_that_phase():
+    nm = NetEm(seed=1)
+    nm.add("a->b loss=1", tag="phase:one")
+    nm.add("c->d loss=1", tag="phase:two")
+    assert nm.remove_tag("phase:one") == 1
+    assert nm.decide("a", "b", 1) is None
+    assert nm.decide("c", "d", 1).drop
+    nm.clear()
+    assert not nm.armed
+
+
+def test_rate_cap_store_and_forward_queuing():
+    clk = [0.0]
+    nm = NetEm(seed=1, clock=lambda: clk[0])
+    nm.add("a->b rate=1000")  # 1000 bytes/s
+    assert nm.decide("a", "b", 500).delays[0] == pytest.approx(0.5)
+    # second message queues behind the first's transmission
+    assert nm.decide("a", "b", 500).delays[0] == pytest.approx(1.0)
+    clk[0] = 10.0  # link long idle: no queue, only its own tx time
+    assert nm.decide("a", "b", 250).delays[0] == pytest.approx(0.25)
+
+
+# -- in-process hub integration ----------------------------------------------
+
+
+def _hub(names=("a", "b", "c")):
+    from harmony_tpu.p2p import InProcessNetwork
+
+    net = InProcessNetwork()
+    hosts = {n: net.host(n) for n in names}
+    inbox: dict = {n: [] for n in names}
+    for n, h in hosts.items():
+        h.subscribe("t", lambda _t, p, frm, n=n: inbox[n].append(
+            (frm, p)
+        ))
+    return net, hosts, inbox
+
+
+def test_hub_disarmed_is_synchronous_and_threadless():
+    net, hosts, inbox = _hub()
+    assert net.netem is None
+    hosts["a"].publish("t", b"x")
+    # no conditioner: delivery happened INLINE, before publish returned
+    assert inbox["b"] == [("a", b"x")] and inbox["c"] == [("a", b"x")]
+
+
+def test_hub_armed_nonmatching_stays_inline():
+    net, hosts, inbox = _hub()
+    net.netem = NetEm(seed=1)
+    net.netem.add("x->y delay=500ms")  # matches nobody here
+    hosts["a"].publish("t", b"x")
+    assert inbox["b"] == [("a", b"x")]
+    assert net.netem._thread is None  # scheduler never spawned
+    net.netem.close()
+
+
+def test_hub_loss_is_asymmetric():
+    net, hosts, inbox = _hub()
+    net.netem = NetEm(seed=1)
+    net.netem.add("a->b loss=1")
+    hosts["a"].publish("t", b"ping")
+    hosts["b"].publish("t", b"pong")
+    time.sleep(0.05)
+    assert inbox["b"] == []                    # a->b black-holed
+    assert ("a", b"ping") in inbox["c"]        # a->c untouched
+    assert ("b", b"pong") in inbox["a"]        # b->a untouched
+    assert net.netem.totals()["dropped"] == 1
+    net.netem.close()
+
+
+def test_hub_delay_defers_then_delivers():
+    net, hosts, inbox = _hub()
+    net.netem = NetEm(seed=1)
+    net.netem.add("a->* delay=120ms")
+    hosts["a"].publish("t", b"slow")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and (
+        not inbox["b"] or not inbox["c"]
+    ):
+        time.sleep(0.01)
+    assert inbox["b"] == [("a", b"slow")]
+    assert inbox["c"] == [("a", b"slow")]
+    assert net.netem.totals()["delayed"] == 2
+    net.netem.close()
+
+
+def test_hub_duplication_delivers_both_copies():
+    net, hosts, inbox = _hub(("a", "b"))
+    net.netem = NetEm(seed=1)
+    net.netem.add("a->b delay=20ms dup=100%")
+    hosts["a"].publish("t", b"twice")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(inbox["b"]) < 2:
+        time.sleep(0.01)
+    assert inbox["b"] == [("a", b"twice")] * 2
+    assert net.netem.totals()["duplicated"] == 1
+    net.netem.close()
+
+
+def test_hub_delayed_delivery_skips_late_partition():
+    """A message in flight when its destination is partitioned must
+    NOT arrive: the chokepoint re-checks partition state at delivery
+    time."""
+    net, hosts, inbox = _hub(("a", "b"))
+    net.netem = NetEm(seed=1)
+    net.netem.add("a->b delay=150ms")
+    hosts["a"].publish("t", b"late")
+    net.partitioned.add("b")
+    time.sleep(0.4)
+    assert inbox["b"] == []
+    net.partitioned.clear()
+    net.netem.close()
+
+
+def test_netem_metrics_exposition():
+    net, hosts, _ = _hub(("a", "b"))
+    net.netem = NetEm(seed=1)
+    net.netem.add("a->b loss=1")
+    hosts["a"].publish("t", b"x")
+    text = NE.expose()
+    assert "# TYPE harmony_netem_events_total counter" in text
+    assert 'harmony_netem_events_total{event="dropped",rule="a->b"}' \
+        in text
+    # and the process registry carries the family (module imported)
+    from harmony_tpu.metrics import Registry
+
+    assert "harmony_netem_events_total" in Registry().expose()
+    net.netem.close()
+
+
+# -- TCPHost publish path ----------------------------------------------------
+
+
+def test_tcphost_publish_path_conditioned():
+    from harmony_tpu.p2p.host import TCPHost
+
+    a = TCPHost(name="wan-a")
+    b = TCPHost(name="wan-b")
+    got = []
+    b.subscribe("t", lambda _t, p, frm: got.append((frm, p)))
+    try:
+        a.connect(b.port)
+        assert a.wait_for_peers(1) and b.wait_for_peers(1)
+        a.netem = NetEm(seed=2)
+        a.netem.add("wan-a->wan-b delay=100ms")
+        a.publish("t", b"over-the-wan")
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.02)
+        assert got and got[0][1] == b"over-the-wan"
+        assert a.netem.totals()["delayed"] >= 1
+    finally:
+        if a.netem is not None:
+            a.netem.close()
+        a.close()
+        b.close()
+
+
+# -- sync downloader: EWMA peer ordering (ISSUE 15 satellite) ---------------
+
+
+class _StubClient:
+    pass
+
+
+def test_downloader_ewma_orders_slow_peers_last():
+    from harmony_tpu.sync.staged import Downloader
+
+    a, b, c = _StubClient(), _StubClient(), _StubClient()
+    dl = Downloader(chain=None, clients=[a, b, c], verify_seals=False)
+    # unmeasured: configured order (stable sort at EWMA 0)
+    assert dl._peers() == [a, b, c]
+    # the drip-feeder: answers just under the deadline every window —
+    # before the EWMA ordering it won every _fetch_window race forever
+    for _ in range(4):
+        dl._note_latency(a, 1.9)
+        dl._note_latency(b, 0.05)
+        dl._note_latency(c, 0.2)
+    assert dl._peers() == [b, c, a]
+    # exclusion still per-pass, on top of the ordering
+    dl._excluded.add(id(b))
+    assert dl._peers() == [c, a]
+    dl._excluded.clear()
+    # one fast answer does not erase a slow history (EWMA, not last)
+    dl._note_latency(a, 0.01)
+    assert dl._peers()[0] is b and dl._peers()[-1] is a
+
+
+def test_downloader_call_feeds_ewma():
+    from harmony_tpu.sync.staged import Downloader
+
+    c1 = _StubClient()
+    dl = Downloader(chain=None, clients=[c1], verify_seals=False)
+    assert dl._call(c1, lambda x: x + 1, 41) == 42
+    assert id(c1) in dl._lat
+    # a raising call leaves the EWMA untouched (exclusion handles it)
+    before = dict(dl._lat)
+    with pytest.raises(ConnectionError):
+        dl._call(c1, _raise)
+    assert dl._lat == before
+
+
+def _raise():
+    raise ConnectionError("peer gone")
+
+
+# -- vc_timeout ladder vs a fixed netem delay (ISSUE 15 satellite) ----------
+
+
+def test_vc_timeout_ladder_outpaces_fixed_netem_delay():
+    """The de-sync class PR 8 fixed, pinned against LATENCY rather
+    than loss: under a fixed netem one-way delay D, one full
+    view-change exchange needs ~2 hops (VC vote out, NEWVIEW back).
+    A CONSTANT timeout below 2D times out every view forever and the
+    committee never converges; the escalating vc_timeout ladder
+    (base * min(1+vc, 8)) must cross 2D at a predictable escalation —
+    and its 8x cap keeps a truly dead network bounded."""
+    from harmony_tpu.node.node import Node
+
+    nm = NetEm(seed=3)
+    nm.add("*->* delay=450ms")
+    d = nm.decide("v0", "v1", 256)
+    one_way = d.delays[0]
+    assert one_way == pytest.approx(0.45)  # fixed: no jitter armed
+    # the netem schedule is deterministic: every hop costs exactly D
+    assert nm.decide("v1", "v0", 256).delays[0] == one_way
+    exchange = 2 * one_way
+
+    node = Node.__new__(Node)  # vc_timeout reads only these two
+    node.phase_timeout = 0.2
+    # constant timeout (the bug class): base < exchange, every rung
+    # identical, never outpaces the wire
+    node._vc = 0
+    assert all(node.vc_timeout() < exchange for _ in range(16))
+    # the ladder: grows linearly until a window fits the exchange
+    converged_at = None
+    for k in range(16):
+        node._vc = k
+        if node.vc_timeout() > exchange:
+            converged_at = k
+            break
+    # 0.2 * (1+4) = 1.0 > 0.9: escalation 4, deterministically
+    assert converged_at == 4
+    # and the reference's 8x cap bounds the ladder: past-cap latency
+    # is a dead network, not a slow one
+    node._vc = 100
+    assert node.vc_timeout() == pytest.approx(0.2 * 8)
+
+
+# -- scenario vocabulary ----------------------------------------------------
+
+
+def test_new_scenarios_registered_and_buildable():
+    from harmony_tpu.chaostest.scenarios import SCENARIOS
+
+    for name in ("gray_leader", "asymmetric_partition",
+                 "minority_partition_heal", "wan_committee"):
+        s = SCENARIOS[name](quick=True)
+        assert s.name == name and s.phases
+    wan = SCENARIOS["wan_committee"](quick=True)
+    assert wan.topology.committee_size >= 64
+    # the WAN matrix spec parses through the production grammar
+    rule = NE.parse_link(wan.phases[0].links[0])
+    assert rule.rtt_ms == (50.0, 150.0)
+    heal = SCENARIOS["minority_partition_heal"](quick=True)
+    assert heal.phases[0].cut_sync and heal.phases[0].measure_heal

@@ -81,6 +81,107 @@ def test_committee_assignment_round_robin_and_shard_by_key():
     assert len(ext) == 4
 
 
+def test_mainnet_200_slot_roster_election():
+    """200-slot roster election at the reference's mainnet shape
+    (ROADMAP item 2, mirroring one-node-staked-vote_test.go: elect at
+    scale, then check the voting-power split): multi-key operators
+    spread stakes over exactly 200 BLS slots, the auction fills every
+    slot with the right ordering / spread / EPoS clamping, committee
+    assignment shards the winners, and voting power sums to exactly
+    one.  The roster's first four operators ARE the wan_committee
+    chaos topology's live 64-key committee (dev_genesis keys, 4 nodes
+    x 16 keys, via the same chaostest fixture) — the binding the live
+    WAN scenario runs is the binding this election elects."""
+    from harmony_tpu.chaostest import fixtures as FX
+    from harmony_tpu.consensus import votepower as VP
+    from harmony_tpu.core.genesis import dev_genesis
+    from harmony_tpu.numeric import new_dec
+
+    genesis, _, bls_keys = dev_genesis(n_accounts=4, n_keys=64)
+    live = [k.pub.bytes for k in bls_keys]
+    assert live == list(genesis.committee)  # the wan_committee keys
+
+    orders, key_owner = FX.mainnet_roster(
+        slots=200, seed=5, committee_keys=live
+    )
+    assert sum(len(o.spread_among) for o in orders.values()) == 200
+
+    med, picks = E.apply(orders, pull=200)
+    assert len(picks) == 200
+    # slot ordering: raw stake non-increasing across the full roster
+    stakes = [p.raw_stake.raw for p in picks]
+    assert stakes == sorted(stakes, reverse=True)
+    # multi-key operator binding: every winning key belongs to its
+    # operator, and an operator's keys all carry the SAME truncated
+    # spread (stake // n_keys semantics)
+    per_op_spreads: dict = {}
+    for p in picks:
+        assert key_owner[p.key] == p.addr
+        per_op_spreads.setdefault(p.addr, set()).add(p.raw_stake.raw)
+    assert all(len(s) == 1 for s in per_op_spreads.values())
+    assert any(
+        len(o.spread_among) == 16 for o in orders.values()
+    )  # the wan operators really are 16-key
+    # the live 64-key committee out-stakes every synthetic operator:
+    # it wins slots — and exactly the TOP 64 of them
+    assert {p.key for p in picks[:64]} == set(live)
+    # EPoS clamping: every effective stake inside [1-c, 1+c] * median
+    hi = new_dec(1).add(E.C_BOUND).mul(med)
+    lo = new_dec(1).sub(E.C_BOUND).mul(med)
+    for p in picks:
+        assert not p.epos_stake.gt(hi) and not lo.gt(p.epos_stake)
+
+    # committee assignment at 4 shards (reference: 200 external slots
+    # total, winners land on shard (key mod shard_count))
+    hmy = [(f"h{i}".encode(), f"hk{i}".encode()) for i in range(8)]
+    state = SC.epos_staked_committee(
+        epoch=7,
+        shard_count=4,
+        harmony_accounts=hmy,
+        harmony_per_shard=2,
+        orders=orders,
+        external_slots_total=200,
+    )
+    ext = [
+        s for c in state.shards for s in c.slots
+        if s.effective_stake is not None
+    ]
+    assert len(ext) == 200
+    for c in state.shards:
+        assert len(c.slots) >= 2  # harmony slots seated round-robin
+        for s in c.slots[2:]:
+            assert int.from_bytes(s.bls_pubkey, "big") % 4 == c.shard_id
+
+    # voting power (the one-node-staked-vote_test.go assertion shape):
+    # harmony slots split their configured 49% equally, the staked
+    # slots split 51% pro-rata by effective stake, and the total is
+    # forced to EXACTLY one
+    shard0 = state.shards[0]
+    roster = VP.compute_roster(
+        [
+            VP.Slot(
+                address=s.ecdsa_address,
+                bls_pubkey=s.bls_pubkey,
+                effective_stake=s.effective_stake,
+            )
+            for s in shard0.slots
+        ],
+        harmony_percent=Dec.from_str("0.49"),
+        external_percent=Dec.from_str("0.51"),
+    )
+    assert roster.harmony_slot_count == 2
+    assert roster.our_voting_power.add(
+        roster.their_voting_power
+    ).equal(new_dec(1))
+    hmy_voters = [
+        v for v in roster.voters.values() if v.is_harmony
+    ]
+    assert all(
+        v.overall_percent.equal(Dec.from_str("0.245"))
+        for v in hmy_voters
+    )
+
+
 def test_committee_rotation_at_epoch_boundary():
     """Full rotation arc on a real chain, via the SAME chaostest
     fixtures the election-under-load scenario composes: a staked

@@ -316,3 +316,35 @@ def test_conflicting_prepared_payloads_rejected(committee):
         VC.construct_viewchange(keysets[1], view_id, 16, hash_b, proof_b)
     )
     assert coll.m1_payload == VC.m1_payload(hash_a, proof_a)
+
+
+def test_aggregate_public_honors_twin_mode(monkeypatch, committee):
+    """Twin-mode regression (found by minority_partition_heal): the
+    NEWVIEW verify path asks for the device tree-sum, but twins keep
+    jax UNLOADED by contract — aggregate_public must fall back to the
+    host path instead of compiling a fresh XLA masked-sum on the
+    consensus pump thread (the first NEWVIEW at a new committee width
+    used to wedge every validator's pump for a full XLA:CPU compile,
+    ~90 s at width 7)."""
+    from harmony_tpu.consensus.mask import Mask
+    from harmony_tpu.ref import bls as RB
+
+    _, keys = committee
+    points = [RB.pubkey_from_bytes(k) for k in keys]
+    mask = Mask(points)
+    for i in range(len(points)):
+        mask.set_bit(i, True)
+    monkeypatch.setenv("HARMONY_KERNEL_TWIN", "1")
+    # the device kernels must never be touched under twins — make any
+    # excursion into ops.curve a loud failure
+    import harmony_tpu.ops.curve as CV
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "aggregate_public compiled a device masked-sum under twins"
+        )
+
+    monkeypatch.setattr(CV, "masked_sum", _boom)
+    got = mask.aggregate_public(device=True)
+    want = RB.aggregate_pubkeys(points)
+    assert got == want

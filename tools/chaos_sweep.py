@@ -1,4 +1,4 @@
-"""Adversarial scenario sweep: run the five named chaos scenarios and
+"""Adversarial scenario sweep: run the named chaos scenarios and
 gate on their liveness invariants.
 
 Each scenario (harmony_tpu/chaostest/scenarios.py) composes a

@@ -80,6 +80,20 @@
 #      recovers) scenarios via chaos_sweep; soak_* + overload
 #      metrics land as an ephemeral BENCH round gated by
 #      bench_ledger --check.
+#  11. WAN netem — the gray-failure tier (ISSUE 15): the netem /
+#      roster unit tiers (link-spec grammar, seed-deterministic
+#      delivery schedules, both transport integrations, sync EWMA
+#      peer ordering, the 200-slot roster election), then the four
+#      netem scenarios via chaos_sweep --quick --check: gray_leader
+#      (leader degraded to 300 ms + jitter + 5 % loss — commit or
+#      view-change, never wedge), asymmetric_partition (half-duplex
+#      leader: sends, cannot receive; NEWVIEW without it),
+#      minority_partition_heal (validator fully isolated >= 8 blocks
+#      then healed; measured heal_catchup_seconds), wan_committee
+#      (64-slot committee under a 50-150 ms RTT / 0.5 % loss WAN
+#      matrix; round p99 in the ledger); chaos_*/netem_* metrics
+#      land as an ephemeral BENCH round gated by bench_ledger
+#      --check.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -131,7 +145,8 @@ CHAOS_ROUND="$(mktemp)"
 CRASH_ROUND="$(mktemp)"
 BYZ_ROUND="$(mktemp)"
 SOAK_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND"' EXIT
+NETEM_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND" "$NETEM_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --scenario view_change_storm --scenario epoch_election_rotation \
   --scenario cross_shard_partition --scenario validator_churn \
@@ -182,5 +197,17 @@ JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --bench-round 996 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$SOAK_ROUND" > /dev/null
+
+echo "== WAN netem: gray-failure tier + mainnet-shape committee =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_netem.py \
+  tests/test_staking_shard.py
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario gray_leader --scenario asymmetric_partition \
+  --scenario minority_partition_heal --scenario wan_committee \
+  --bench-out "$NETEM_ROUND" --bench-round 995 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$NETEM_ROUND" > /dev/null
 
 echo "check.sh: OK"
