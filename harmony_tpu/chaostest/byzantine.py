@@ -280,6 +280,7 @@ class ByzantineNode(Node):
     def run_forever(self, *args, **kwargs):
         if "wire_spray" in self.behaviors and self._spray_thread is None:
             self._spray_thread = threading.Thread(
+                # graftlint: thread-role=transient — scenario-scoped
                 target=self._spray_loop, daemon=True,
             )
             self._spray_thread.start()

@@ -465,6 +465,7 @@ class NetEm:
 
         hb = health.register("netem.delivery")
         t = threading.Thread(
+            # graftlint: thread-role=netem.scheduler
             target=self._run, args=(hb,), daemon=True,
             name="netem-delivery",
         )

@@ -1144,13 +1144,16 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
             ))
         for _ in range(tr.replay_workers):
             threads.append(threading.Thread(
+                # graftlint: thread-role=transient — scenario-scoped
                 target=_replay_worker, args=(env, stop), daemon=True,
             ))
         if tr.cross_shard_transfers and scenario.topology.shards > 1:
             threads.append(threading.Thread(
+                # graftlint: thread-role=transient — scenario-scoped
                 target=_cx_submitter, args=(env, stop), daemon=True,
             ))
         threads.append(threading.Thread(
+            # graftlint: thread-role=transient — scenario-scoped
             target=_round_collector, args=(env, stop), daemon=True,
         ))
         # the timeline rides the same joined pool: it must be DOWN
@@ -1158,6 +1161,7 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         # a racing phase trigger could re-arm rules into the next
         # scenario of this process
         timeline = threading.Thread(
+            # graftlint: thread-role=transient — scenario-scoped
             target=_timeline, args=(env, stop, t0, phases_done),
             daemon=True,
         )

@@ -455,7 +455,9 @@ def build_node(cfg: dict):
                     client = None  # beacon peer away: retry next tick
                 feed_stop.wait(30.0)
 
-        feed_thread = _threading.Thread(target=_feed_loop, daemon=True)
+        feed_thread = _threading.Thread(
+            target=_feed_loop, daemon=True,
+        )  # graftlint: thread-role=serving — devnet feed, /readyz covers it
         manager.register(
             ServiceType.CROSSLINK_SENDING,  # beacon-follow service slot
             _CallbackService(feed_thread.start, feed_stop.set),

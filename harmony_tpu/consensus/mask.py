@@ -44,7 +44,7 @@ class Mask:
     def __init__(self, publics):
         self.publics = list(publics)
         self.bitmap = bytearray(self.bytes_len())
-        self._device_pks = None
+        self._device_pks = [None]  # one-slot device-tensor cache
         self._index = {}
         for i, pk in enumerate(self.publics):
             key = RB.pubkey_to_bytes(pk)
@@ -119,32 +119,25 @@ class Mask:
         bigints (both bitwise-identical, tested).  Twin mode
         (``device.kernel_twin_active``) forces the host path even when
         a caller asks for the device: twins keep jax UNLOADED by
-        contract, and this is the one device call reachable OUTSIDE
-        device.py's guarded dispatch — the NEWVIEW verify path used to
-        compile a fresh XLA masked-sum ON THE CONSENSUS PUMP THREAD
-        the first time a committee width appeared, wedging every
-        validator's pump for the length of an XLA:CPU compile
-        (~90 s at width 7; found by the minority_partition_heal chaos
-        scenario, whose view changes are the first to exercise NEWVIEW
-        adoption at unusual committee widths)."""
+        contract.  The device path goes through
+        ``device.masked_pubkey_sum`` — breaker-guarded dispatch, like
+        every other device call.  It used to be the one device call
+        OUTSIDE guarded dispatch: the NEWVIEW verify path compiled a
+        fresh XLA masked-sum ON THE CONSENSUS PUMP THREAD the first
+        time a committee width appeared, wedging every validator's
+        pump for the length of an XLA:CPU compile (~90 s at width 7;
+        found by the minority_partition_heal chaos scenario, whose
+        view changes are the first to exercise NEWVIEW adoption at
+        unusual committee widths — and now caught statically by
+        graftlint GL12)."""
         from .. import device as DV
 
         if (not device or DV.kernel_twin_active()
                 or len(self.publics) == 0):
             # native Jacobian sum when available, affine bigint otherwise
             return RB.aggregate_pubkeys(self.get_signed_pubkeys())
-        import jax.numpy as jnp
-
-        from ..ops import curve as CV
-        from ..ops import interop as I
-
-        if self._device_pks is None:
-            self._device_pks = jnp.asarray(
-                np.stack(
-                    [I.g1_affine_to_jacobian_arr(p) for p in self.publics]
-                )
-            )
-        agg = CV.masked_sum(
-            self._device_pks, jnp.asarray(self.bit_vector()), CV.FP_OPS
+        return DV.masked_pubkey_sum(
+            self.publics, self.bit_vector(),
+            lambda: RB.aggregate_pubkeys(self.get_signed_pubkeys()),
+            cache=self._device_pks,
         )
-        return I.arr_to_g1_affine(np.array(agg))

@@ -104,9 +104,9 @@ def decode_tx(blob: bytes) -> Transaction:
     if not f.eof():  # EIP-2930-shaped typed tail (types.py)
         tx_type = f.int_(1)
         if tx_type == 1:
-            for _ in range(f.int_(2)):
+            for _ in range(_checked_count(f, 2)):
                 addr = f.bytes_()
-                slots = [f.bytes_() for _ in range(f.int_(2))]
+                slots = [f.bytes_() for _ in range(_checked_count(f, 2))]
                 access_list.append((addr, slots))
     return Transaction(
         nonce=nonce, gas_price=gas_price, gas_limit=gas_limit,
@@ -320,7 +320,7 @@ def read_receipts(db, num: int) -> list:
     if blob is None:
         return []
     r = _Reader(blob)
-    return [Receipt.decode(r) for _ in range(r.int_(4))]
+    return [Receipt.decode(r) for _ in range(_checked_count(r))]
 
 
 def write_outgoing_cx(db, to_shard: int, num: int, cxs: list):
@@ -338,7 +338,7 @@ def read_outgoing_cx(db, to_shard: int, num: int) -> list:
     if blob is None:
         return []
     r = _Reader(blob)
-    return [decode_cx(r.bytes_()) for _ in range(r.int_(4))]
+    return [decode_cx(r.bytes_()) for _ in range(_checked_count(r))]
 
 
 def write_cx_spent(db, from_shard: int, num: int, spender: int = 0):

@@ -248,9 +248,9 @@ class Receipt:
         cumulative = r.int_()
         contract = r.bytes_()
         logs = []
-        for _ in range(r.int_(4)):
+        for _ in range(r.checked_count(4)):
             addr = r.bytes_()
-            topics = [r.bytes_() for _ in range(r.int_(2))]
+            topics = [r.bytes_() for _ in range(r.checked_count(2))]
             logs.append((addr, topics, r.bytes_()))
         return cls(tx_hash, status, gas_used, cumulative,
                    logs=logs, contract_address=contract)

@@ -470,6 +470,61 @@ def agg_verify_hashed_on_device(table: CommitteeTable, bits, h_point,
                     lambda: _ref_agg_verify(table, bits, h, sig_point))
 
 
+def masked_pubkey_sum(points, bits, fallback, cache=None):
+    """Masked Jacobian tree-sum of a pubkey list, breaker-guarded.
+
+    The NEWVIEW adoption path aggregates a *candidate* mask's pubkeys
+    — a mask that is not this node's own, so the committee-table
+    bucket cache doesn't apply.  ``cache`` is an optional one-slot
+    list holding the device-resident stacked point tensor across
+    calls on the same mask (the CommitteeTable idiom without the
+    bucket padding: masks own their width).
+
+    This used to be the one device call outside guarded dispatch (the
+    PR-15 pump-wedge class): a raising backend now degrades to the
+    host ``fallback`` instead of surfacing into consensus, an OPEN
+    breaker skips the device entirely, and the dispatch rides the
+    same trace span / deadline accounting as every other kind.
+    Callers keep the twin early-out (twins keep jax unloaded), but a
+    twin activating between check and dispatch still falls back here
+    rather than importing jax.
+    """
+    if kernel_twin_active():
+        return fallback()
+    COUNTERS.inc("masked_pubkey_sum")
+
+    def dispatch():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .ops import curve as CV
+        from .ops import interop as I
+
+        pks = cache[0] if cache is not None else None
+        if pks is None:
+            pks = jnp.asarray(np.stack(
+                [I.g1_affine_to_jacobian_arr(p) for p in points]))
+            if cache is not None:
+                cache[0] = pks
+        bm = np.asarray(bits)
+        TRANSFER.inc("h2d", bm.nbytes)
+        program = f"masked_sum_w{len(points)}"
+        first = _program_first_use(program)
+        t0 = time.monotonic()
+        agg = CV.masked_sum(pks, jnp.asarray(bm), CV.FP_OPS)
+        res = np.asarray(agg)
+        elapsed = time.monotonic() - t0
+        if first:
+            JIT_COMPILE_SECONDS.set(elapsed, program=program)
+        TRANSFER.inc("d2h", res.nbytes)
+        trace.annotate(program=program, width=len(points),
+                       jit_cache="miss" if first else "hit",
+                       h2d_bytes=bm.nbytes, d2h_bytes=res.nbytes)
+        return I.arr_to_g1_affine(res)
+
+    return _guarded("masked_pubkey_sum", dispatch, fallback)
+
+
 # Pinned batch widths for the replay path (same rationale as the
 # committee buckets: a handful of compiled programs covers every batch
 # size).  CPU caps at 64 — XLA:CPU's LLVM JIT struggles with the

@@ -282,6 +282,7 @@ class ResourceGovernor:
             return self
         self._stop.clear()
         self._thread = threading.Thread(
+            # graftlint: thread-role=governor.sampler
             target=self._loop, name="governor-sampler", daemon=True,
         )
         self._thread.start()

@@ -224,6 +224,7 @@ def _ensure_watchdog_locked() -> None:
     if _watchdog is not None and _watchdog.is_alive():
         return
     _watchdog = threading.Thread(
+        # graftlint: thread-role=watchdog
         target=_watch_loop, args=(_stop,), name="health-watchdog",
         daemon=True,
     )

@@ -1563,7 +1563,9 @@ class Node:
                     self._stop.wait(poll_interval)
             hb.close()
 
-        t = threading.Thread(target=loop, daemon=True)
+        t = threading.Thread(
+            target=loop, daemon=True,
+        )  # graftlint: thread-role=consensus.pump
         t.start()
         hb.bind(t)
         return t

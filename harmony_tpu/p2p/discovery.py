@@ -110,7 +110,9 @@ class Discovery:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "Discovery":
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+        )  # graftlint: thread-role=serving
         self._thread.start()
         return self
 

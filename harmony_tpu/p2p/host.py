@@ -453,6 +453,7 @@ class TCPHost(Host):
         for i in range(self.VALIDATE_WORKERS):
             hb = health.register(f"p2p.validate[{name}#{i}]")
             t = threading.Thread(
+                # graftlint: thread-role=serving
                 target=self._validate_worker, args=(hb,), daemon=True,
                 name=f"p2p-validate-{name}-{i}",
             )
@@ -461,6 +462,7 @@ class TCPHost(Host):
             self._hbs.append(hb)
         mesh_hb = health.register(f"p2p.mesh[{name}]")
         t = threading.Thread(
+            # graftlint: thread-role=serving
             target=self._heartbeat_loop, args=(mesh_hb,), daemon=True,
             name=f"p2p-heartbeat-{name}",
         )
@@ -472,7 +474,9 @@ class TCPHost(Host):
         self._srv.bind(("127.0.0.1", listen_port))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(
+            target=self._accept_loop, daemon=True,  # graftlint: thread-role=serving
+        ).start()
 
     # -- wire ---------------------------------------------------------------
 
@@ -504,6 +508,7 @@ class TCPHost(Host):
                 sock.close()
                 continue
             threading.Thread(
+                # graftlint: thread-role=transient — per-connection
                 target=self._peer_loop, args=(sock, addr[0]), daemon=True
             ).start()
 
@@ -513,6 +518,7 @@ class TCPHost(Host):
             sock.close()
             raise ConnectionError("gater refused outbound peer")
         threading.Thread(
+            # graftlint: thread-role=transient — per-connection
             target=self._peer_loop, args=(sock, host), daemon=True
         ).start()
 

@@ -93,7 +93,9 @@ class SyncServer:
         self._srv.bind(("127.0.0.1", listen_port))
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(
+            target=self._accept_loop, daemon=True,  # graftlint: thread-role=serving
+        ).start()
 
     def _accept_loop(self):
         while not self._closing:
@@ -102,6 +104,7 @@ class SyncServer:
             except OSError:
                 return
             threading.Thread(
+                # graftlint: thread-role=transient — per-connection
                 target=self._serve_conn, args=(sock,), daemon=True
             ).start()
 
@@ -325,6 +328,7 @@ class SyncClient:
             if self._sock is None:
                 self._sock = sock
                 threading.Thread(
+                    # graftlint: thread-role=transient — per-connection
                     target=self._read_loop, args=(sock,), daemon=True
                 ).start()
                 return sock

@@ -101,6 +101,7 @@ class WSServer:
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
         self._thread = threading.Thread(
+            # graftlint: thread-role=serving
             target=self._accept_loop, daemon=True
         )
 
@@ -124,6 +125,7 @@ class WSServer:
             except OSError:
                 return
             threading.Thread(
+                # graftlint: thread-role=transient — per-connection
                 target=self._serve_conn, args=(sock,), daemon=True
             ).start()
 
@@ -175,7 +177,9 @@ class WSServer:
         try:
             if not self._handshake(sock):
                 return
-            threading.Thread(target=pusher, daemon=True).start()
+            threading.Thread(
+                target=pusher, daemon=True,  # graftlint: thread-role=transient
+            ).start()
             while not self._closing:
                 frame = read_frame(sock)
                 if frame is None:

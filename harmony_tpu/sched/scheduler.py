@@ -245,6 +245,7 @@ class VerifyScheduler:
         self._backend_cond = threading.Condition()
         self._backend_batches: deque = deque()
         self._backend_thread: threading.Thread | None = None
+        self._backend_hb = None
         self._ewma_dispatch_s = 0.0
         self._hb = None  # health.Heartbeat once start() registers it
 
@@ -258,6 +259,7 @@ class VerifyScheduler:
                 return self
             self._running = True
         self._thread = threading.Thread(
+            # graftlint: thread-role=sched.flush
             target=self._loop, name="sched-flush", daemon=True
         )
         self._thread.start()
@@ -285,6 +287,7 @@ class VerifyScheduler:
             if t is not None and t.is_alive():
                 return False
         thread = threading.Thread(
+            # graftlint: thread-role=sched.flush
             target=self._loop, name="sched-flush", daemon=True
         )
         # started BEFORE being published: stop() joins self._thread,
@@ -746,25 +749,49 @@ class VerifyScheduler:
     # -- the sidecar-backend worker ------------------------------------------
 
     def _enqueue_backend(self, batch: list) -> None:
+        from .. import health
+
+        spawned = None
         with self._backend_cond:
             if (self._backend_thread is None
                     or not self._backend_thread.is_alive()):
                 self._backend_thread = threading.Thread(
+                    # graftlint: thread-role=sched.flush
                     target=self._backend_loop, name="sched-backend",
                     daemon=True,
                 )
                 self._backend_thread.start()
+                spawned = self._backend_thread
             self._backend_batches.append(batch)
             self._backend_cond.notify()
+        if spawned is not None:
+            # registered OUTSIDE _backend_cond (health._LOCK nests
+            # under no scheduler lock — GL05).  Non-critical, no
+            # restart hook: a dead worker is respawned lazily by the
+            # next enqueue, but a WEDGED one (stuck in a sidecar call)
+            # must show up stale on /healthz instead of silently
+            # stalling verify futures
+            self._backend_hb = health.register(
+                "sched.backend", thread=spawned,
+            )
 
     def _backend_loop(self) -> None:
         while True:
+            # re-read each pass: _enqueue_backend registers the
+            # heartbeat only AFTER the thread is running
+            hb = self._backend_hb
             with self._backend_cond:
                 while self._running and not self._backend_batches:
+                    if hb is not None:
+                        hb.idle()  # parked empty: healthy, not wedged
                     self._backend_cond.wait()
                 if not self._backend_batches:
+                    if hb is not None:
+                        hb.close()
                     return
                 batch = self._backend_batches.popleft()
+            if hb is not None:
+                hb.beat()
             self._run_backend(batch)
 
     def _run_backend(self, batch: list) -> None:

@@ -149,6 +149,7 @@ class SidecarClient:
                     self._ready = ready = threading.Event()
                     replay = True
                     threading.Thread(
+                        # graftlint: thread-role=sidecar.reader
                         target=self._read_loop, args=(dialed,),
                         daemon=True,
                     ).start()

@@ -80,7 +80,9 @@ class SidecarServer:
 
     # --- lifecycle ---
     def start(self):
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+        )  # graftlint: thread-role=serving
         self._thread.start()
         return self
 
@@ -115,6 +117,7 @@ class SidecarServer:
             with self._lock:
                 self._conns.add(conn)
             threading.Thread(
+                # graftlint: thread-role=transient — per-connection
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 

@@ -38,7 +38,9 @@ class Pool:
         self.closed = True  # not lock-guarded anywhere: clean
 
     def start(self):
-        threading.Thread(target=self._worker, daemon=True).start()
+        threading.Thread(
+            target=self._worker, daemon=True,  # graftlint: thread-role=transient
+        ).start()
 
     def _worker(self):
         while not self.closed:

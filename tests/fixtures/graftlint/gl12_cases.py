@@ -1,0 +1,78 @@
+"""GL12 fixtures: thread-role dispatch discipline — positive, negative.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+The positive cases re-create the PR-15 ``aggregate_public`` wedge shape:
+a spawn site annotated with a latency-critical role whose loop reaches
+a jax compile (and an unbounded wait) through a helper.  The negative
+cases run the SAME shapes on a non-latency-critical role — compile and
+blocking clauses are role-scoped; only the ops-excursion clause fires
+for every role (twin mode keeps jax unloaded on all of them).
+"""
+
+import threading
+
+import harmony_tpu.ops.curve as CV
+import jax
+from harmony_tpu import health
+
+
+def _pump_compile_helper(xs):
+    fn = jax.jit(lambda a: a)  # expect: GL12
+    return fn(xs)
+
+
+def _serving_compile_helper(xs):
+    fn = jax.jit(lambda a: a)  # compile off the critical path: clean
+    return fn(xs)
+
+
+class Pump:
+    """Latency-critical role: compile AND unbounded blocking flagged."""
+
+    def __init__(self):
+        self.closing = False
+        self.ev = threading.Event()
+        self._hb = None
+
+    def start(self):
+        t = threading.Thread(
+            # graftlint: thread-role=consensus.pump
+            target=self._pump_loop, daemon=True,
+        )
+        t.start()
+        self._hb = health.register("fixture.pump", thread=t)
+
+    def _pump_loop(self):
+        while not self.closing:
+            self._hb.beat()
+            self._step()
+
+    def _step(self):
+        self.ev.wait()  # expect: GL12
+        return _pump_compile_helper([1, 2, 3])
+
+
+class Background:
+    """serving role, same shape: compile/blocking clauses stay quiet,
+    but the ops excursion fires on EVERY role."""
+
+    def __init__(self):
+        self.closing = False
+        self.ev = threading.Event()
+
+    def start(self):
+        threading.Thread(
+            # graftlint: thread-role=serving
+            target=self._loop, daemon=True,
+        ).start()
+
+    def _loop(self):
+        while not self.closing:
+            self.ev.wait()  # serving may park unbounded: clean
+            _serving_compile_helper([1])
+            self._masked()
+
+    def _masked(self, pks=None, bits=None):
+        return CV.masked_sum(pks, bits, CV.FP_OPS)  # expect: GL12

@@ -1,0 +1,61 @@
+"""GL13 fixtures: wire-taint budgets — positive, sanitized, clean.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+The positive cases re-create the PR-13 bug class: a length/count read
+straight off an untrusted blob bounds a loop, sizes an allocation or
+multiplies a payload with no dominating remaining-budget check — a
+4-byte forged prefix buys four billion iterations.  The sanitized
+cases show the two blessed idioms (explicit remaining-bytes guard,
+``min``-clamp) staying quiet.
+"""
+
+import struct
+
+MAX_ITEMS = 1024
+
+
+def decode_unchecked_loop(buf: bytes):
+    n = int.from_bytes(buf[:4], "little")
+    out = []
+    for _ in range(n):  # expect: GL13
+        out.append(buf[:1])
+    return out
+
+
+def decode_unchecked_alloc(buf: bytes):
+    n = int.from_bytes(buf[:4], "little")
+    return bytearray(n)  # expect: GL13
+
+
+def decode_unchecked_mult(buf: bytes):
+    n = int.from_bytes(buf[:4], "little")
+    return b"\x00" * n  # expect: GL13
+
+
+def decode_struct_source(buf: bytes):
+    (n,) = struct.unpack("<I", buf[:4])
+    return bytearray(n)  # expect: GL13
+
+
+def decode_guarded(buf: bytes):
+    """The remaining-budget idiom: every element costs >= 1 byte, so a
+    count that cannot fit in what's left is rejected before the loop."""
+    n = int.from_bytes(buf[:4], "little")
+    if n > len(buf) - 4:
+        raise ValueError("implausible element count")
+    return [buf[:1] for _ in range(n)]
+
+
+def decode_clamped(buf: bytes):
+    n = min(int.from_bytes(buf[:4], "little"), MAX_ITEMS)
+    return [buf[:1] for _ in range(n)]
+
+
+def decode_window(buf: bytes, count: int):
+    """range(start, start + n) iterates n times — a clamped n keeps a
+    tainted START from being a cost bound (it is a lookup key)."""
+    start = int.from_bytes(buf[:4], "little")
+    n = min(count, MAX_ITEMS)
+    return [start + i for i in range(start, start + n)]

@@ -1,0 +1,110 @@
+"""GL14 fixtures: watchdog coverage — positive, compliant, exempt.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+The positive cases re-create the PR-14 gap: a spawned long-lived loop
+the watchdog cannot see — no declared role, an unknown role, a role
+that never registers a Heartbeat, and one that registers but never
+beats (permanently stale).  The compliant case registers AND beats;
+``transient`` threads and bounded targets are exempt by policy.
+"""
+
+import threading
+
+from harmony_tpu import health
+
+
+class NoRole:
+    """Long-lived loop, no thread-role annotation."""
+
+    def start(self):
+        threading.Thread(  # expect: GL14
+            target=self._loop, daemon=True,
+        ).start()
+
+    def _loop(self):
+        while True:
+            step()
+
+
+class BadRole:
+    """Annotated, but the role is not in the registry."""
+
+    def start(self):
+        threading.Thread(  # expect: GL14
+            # graftlint: thread-role=mystery.worker
+            target=self._loop, daemon=True,
+        ).start()
+
+    def _loop(self):
+        while True:
+            step()
+
+
+class NeverRegisters:
+    """sidecar.reader demands a Heartbeat; nothing ever registers."""
+
+    def start(self):
+        threading.Thread(  # expect: GL14
+            # graftlint: thread-role=sidecar.reader
+            target=self._read_loop, daemon=True,
+        ).start()
+
+    def _read_loop(self):
+        while True:
+            pull_frame()
+
+
+class RegistersButSilent:
+    """Registered, but the loop never beats — permanently stale."""
+
+    def start(self):
+        t = threading.Thread(  # expect: GL14
+            # graftlint: thread-role=governor.sampler
+            target=self._loop, daemon=True,
+        )
+        t.start()
+        self._hb = health.register("fixture.silent", thread=t)
+
+    def _loop(self):
+        while True:
+            sample()
+
+
+class Compliant:
+    """Registers at the spawn site and beats in the loop: clean."""
+
+    def start(self):
+        t = threading.Thread(
+            # graftlint: thread-role=netem.scheduler
+            target=self._loop, daemon=True,
+        )
+        t.start()
+        self._hb = health.register("fixture.good", thread=t)
+
+    def _loop(self):
+        while True:
+            self._hb.beat()
+            deliver()
+
+
+class PerConn:
+    """transient threads (bounded lifetime by contract) are exempt."""
+
+    def spawn(self, q):
+        threading.Thread(
+            # graftlint: thread-role=transient — per-connection
+            target=self._serve, args=(q,), daemon=True,
+        ).start()
+
+    def _serve(self, q):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+
+
+def fire_and_forget(fn):
+    """Unresolvable target (a parameter): not statically analyzable."""
+    threading.Thread(target=fn, daemon=True).start()

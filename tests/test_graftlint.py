@@ -52,6 +52,7 @@ def _expected(path: Path) -> set:
     "gl01_cases.py", "gl02_cases.py", "gl03_cases.py", "gl04_cases.py",
     "gl05_cases.py", "gl06_cases.py", "gl07_cases.py", "gl08_cases.py",
     "gl09_cases.py", "gl10_cases.py", "gl11_cases.py",
+    "gl12_cases.py", "gl13_cases.py", "gl14_cases.py",
 ])
 def test_fixture_exact_lines(name):
     """Each rule family flags exactly the tagged lines — no more, no
@@ -210,6 +211,28 @@ def test_cli_exit_code_contract(tmp_path):
                  "--baseline", str(missing_baseline))
     assert r.returncode == 1, r.stdout + r.stderr
     assert "not a .py file or directory" in r.stderr
+
+
+def test_cli_changed_mode_contract():
+    """--changed lints only the git-diff slice.  A bad ref must exit 2
+    (fail loudly), never lint zero files and pass; a narrowed --changed
+    run must refuse to clobber the default baseline; a valid ref runs
+    the gate and reports the changed-slice summary (tree-state agnostic:
+    either files changed vs HEAD, or nothing to lint)."""
+    r = _run_cli("--changed=definitely-not-a-ref")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "definitely-not-a-ref" in r.stderr
+
+    committed = DEFAULT_BASELINE_PATH.read_bytes()
+    r = _run_cli("--changed", "--write-baseline")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "refusing" in r.stderr
+    assert DEFAULT_BASELINE_PATH.read_bytes() == committed
+
+    r = _run_cli("--changed=HEAD")
+    assert r.returncode in (0, 1), r.stdout + r.stderr
+    assert ("changed files vs HEAD" in r.stdout
+            or "nothing to lint" in r.stdout), r.stdout
 
 
 def test_default_baseline_is_committed_and_loads():

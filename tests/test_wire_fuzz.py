@@ -214,3 +214,110 @@ def test_sync_client_rejects_forged_response_counts():
     r = ST._Reader(forged)
     with pytest.raises(ValueError, match="implausible"):
         ST._checked_count(r)
+
+
+# -- ISSUE 16 (GL13 burn-down): decoders the taint pass newly flagged --------
+#
+# GL13 flagged the typed-tx access-list tail of rawdb.decode_tx,
+# Receipt.decode's log/topic counts, and the read_receipts /
+# read_outgoing_cx batch counts as unchecked wire/disk counts; the fix
+# routed each through checked_count.  These mutants pin the same code
+# paths dynamically, so a regression trips both the static and the
+# fuzz tier.
+
+
+class _MemDB(dict):
+    def put(self, k, v):
+        self[k] = v
+
+
+def _typed_tx(access_list):
+    from harmony_tpu.core.types import Transaction
+
+    return Transaction(
+        nonce=0, gas_price=1, gas_limit=21000, shard_id=0, to_shard=0,
+        to=b"\x2d" * 20, value=5, sig=b"", tx_type=1,
+        access_list=access_list,
+    )
+
+
+def test_fuzz_typed_tx_decoder():
+    from harmony_tpu.core import rawdb
+
+    tx = _typed_tx([(b"\xaa" * 20, [b"\x01" * 32, b"\x02" * 32])])
+    _fuzz(rawdb.decode_tx, rawdb.encode_tx(tx, 2))
+
+
+def test_fuzz_receipt_decoder():
+    from harmony_tpu.core.types import Reader, Receipt
+
+    rcpt = Receipt(
+        tx_hash=b"\x11" * 32, status=1, gas_used=21000,
+        cumulative_gas=21000,
+        logs=[(b"\xaa" * 20, [b"\x01" * 32], b"payload")],
+    )
+    _fuzz(lambda blob: Receipt.decode(Reader(blob)), rcpt.encode())
+
+
+def test_tx_access_list_count_inflation_rejected_fast():
+    from harmony_tpu.core import rawdb
+
+    # outer access-list count, then inner slots count: each is the
+    # last field of the signing section, trailed only by the empty
+    # sig's 4-byte length prefix
+    for tx in (_typed_tx([]), _typed_tx([(b"\xaa" * 20, [])])):
+        buf = bytearray(rawdb.encode_tx(tx, 2))
+        struct.pack_into("<H", buf, len(buf) - 6, 0xFFFF)
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="implausible"):
+            rawdb.decode_tx(bytes(buf))
+        assert time.monotonic() - t0 < 0.1
+
+
+def test_receipt_log_and_topic_count_inflation_rejected_fast():
+    from harmony_tpu.core.types import Reader, Receipt
+
+    no_logs = Receipt(tx_hash=b"\x11" * 32, status=1, gas_used=1,
+                      cumulative_gas=1)
+    buf = bytearray(no_logs.encode())
+    struct.pack_into("<I", buf, len(buf) - 4, 0xFFFFFFF0)  # log count
+    with pytest.raises(ValueError, match="implausible"):
+        Receipt.decode(Reader(bytes(buf)))
+
+    one_log = Receipt(tx_hash=b"\x11" * 32, status=1, gas_used=1,
+                      cumulative_gas=1,
+                      logs=[(b"\xaa" * 20, [], b"")])
+    buf = bytearray(one_log.encode())
+    # topic count rides before the empty data's 4-byte length prefix
+    struct.pack_into("<H", buf, len(buf) - 6, 0xFFFF)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="implausible"):
+        Receipt.decode(Reader(bytes(buf)))
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_stored_batch_count_inflation_rejected_fast():
+    """A corrupted (or crash-torn) store blob forging the leading
+    batch count must raise, not spin garbage-object loops."""
+    from harmony_tpu.core import rawdb
+    from harmony_tpu.core.types import CXReceipt, Receipt
+
+    db = _MemDB()
+    rawdb.write_receipts(db, 7, [Receipt(
+        tx_hash=b"\x11" * 32, status=1, gas_used=1, cumulative_gas=1,
+    )])
+    rawdb.write_outgoing_cx(db, 1, 7, [CXReceipt(
+        tx_hash=b"\x22" * 32, sender=b"\x01" * 20, to=b"\x02" * 20,
+        amount=9, from_shard=0, to_shard=1,
+    )])
+    for key in list(db):
+        buf = bytearray(db[key])
+        if len(buf) >= 4:
+            struct.pack_into("<I", buf, 0, 0xFFFFFFF0)
+        db.put(key, bytes(buf))
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="implausible"):
+        rawdb.read_receipts(db, 7)
+    with pytest.raises(ValueError, match="implausible"):
+        rawdb.read_outgoing_cx(db, 1, 7)
+    assert time.monotonic() - t0 < 0.1

@@ -2,11 +2,15 @@
 # Pre-commit gate for harmony-tpu.
 #
 # Three stages, fail-fast:
-#   1. graftlint — whole-program static analysis (GL01-GL11: the
-#      classic families plus the kernelcheck pass — GL09 limb
+#   1. graftlint — whole-program static analysis (GL01-GL14: the
+#      classic families, the kernelcheck pass — GL09 limb
 #      value-range abstract interpretation, GL10 Montgomery-domain
-#      typestate, GL11 twin/padding discipline) against the committed
-#      baseline.  Exit-code contract (stable for hooks): 0 clean,
+#      typestate, GL11 twin/padding discipline — and the thread-role
+#      & trust-boundary pass — GL12 dispatch discipline over the
+#      role-annotated call graph, GL13 wire-taint budgets on every
+#      trust-boundary decoder, GL14 watchdog heartbeat coverage for
+#      spawned long-lived loops) against the committed baseline,
+#      gated at 0 new findings.  Exit-code contract (stable for hooks): 0 clean,
 #      1 new violations, 2 internal linter error — any non-zero stops
 #      this script with the same code.  This stage warms the
 #      content-hash result cache (.graftlint_cache.json), so the
@@ -99,7 +103,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== graftlint: whole-program gate vs committed baseline =="
+echo "== graftlint: whole-program gate vs committed baseline (GL01-GL14) =="
 python -m tools.graftlint
 
 echo "== tier-1 smoke subset =="

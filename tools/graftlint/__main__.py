@@ -16,6 +16,7 @@ from pathlib import Path
 
 from .engine import (
     DEFAULT_BASELINE_PATH,
+    REPO_ROOT,
     RULES,
     Baseline,
     compare,
@@ -23,6 +24,43 @@ from .engine import (
     load_baseline,
     write_baseline,
 )
+
+
+def _changed_scope(ref: str, scope: list) -> list[str] | None:
+    """Repo-relative .py files changed vs ``ref`` (plus untracked),
+    intersected with the requested lint paths.  None on git failure —
+    a bad ref must fail loudly, not lint zero files and exit green."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, check=True,
+            cwd=str(REPO_ROOT)).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True,
+            cwd=str(REPO_ROOT)).stdout.splitlines()
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        print(f"graftlint: --changed={ref}: {detail.strip()}",
+              file=sys.stderr)
+        return None
+    prefixes = []
+    for s in scope:
+        p = Path(s)
+        rel = p.as_posix() if not p.is_absolute() \
+            else p.resolve().relative_to(REPO_ROOT).as_posix()
+        prefixes.append(rel.rstrip("/"))
+    out = []
+    for f in sorted(set(diff) | set(untracked)):
+        if not f.endswith(".py") or "__pycache__" in f:
+            continue
+        if not (REPO_ROOT / f).is_file():
+            continue  # deleted vs ref: nothing to lint
+        if any(f == s or f.startswith(s + "/") for s in prefixes):
+            out.append(f)
+    return out
 
 
 def _parse_args(argv):
@@ -43,6 +81,13 @@ def _parse_args(argv):
     ap.add_argument("--all", action="store_true",
                     help="list every finding (pinned included), not just "
                          "new ones; exit code still gates on NEW only")
+    ap.add_argument("--changed", nargs="?", const="HEAD", metavar="REF",
+                    help="lint only files changed vs a git ref (plus "
+                         "untracked files), intersected with the "
+                         "requested paths — the warm-cache pre-commit "
+                         "loop (default REF: HEAD).  Whole-program "
+                         "rules see only the changed slice; CI still "
+                         "gates the full scope")
     ap.add_argument("--rules",
                     help="comma-separated rule ids to run "
                          "(default: all)")
@@ -109,9 +154,21 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    lint_scope = list(args.paths)
+    if args.changed is not None:
+        changed = _changed_scope(args.changed, args.paths)
+        if changed is None:
+            return 2
+        if not changed:
+            if not args.quiet:
+                print(f"graftlint: no changed .py files vs "
+                      f"{args.changed} in scope — nothing to lint")
+            return 0
+        lint_scope = changed
+
     t0 = time.monotonic()
     program_out: list = [] if args.dot else None
-    result = lint_paths(args.paths, only, program_out=program_out,
+    result = lint_paths(lint_scope, only, program_out=program_out,
                         use_cache=not args.no_cache)
 
     if result.errors:
@@ -129,7 +186,8 @@ def main(argv=None) -> int:
         # a narrowed run (path subset or --rules) sees only a slice of
         # the findings; writing it to the DEFAULT baseline would silently
         # drop every other pin and fail the next full gate
-        narrowed = only is not None or list(args.paths) != ["harmony_tpu"]
+        narrowed = (only is not None or args.changed is not None
+                    or list(args.paths) != ["harmony_tpu"])
         if narrowed and Path(args.baseline).resolve() == \
                 DEFAULT_BASELINE_PATH.resolve():
             print("graftlint: refusing to overwrite the default baseline "
@@ -165,11 +223,18 @@ def main(argv=None) -> int:
             result.by_rule().items())))
     if not args.quiet:
         dt = time.monotonic() - t0
-        msg = (f"graftlint: {len(new)} new, {pinned} pinned, "
-               f"{len(fixed)} baseline entries now fixed "
-               f"({dt:.2f}s)")
-        if fixed:
-            msg += " — shrink the pin file with --write-baseline"
+        if args.changed is not None:
+            # a changed-slice run can't see pins living in unchanged
+            # files, so "fixed" would be noise here
+            msg = (f"graftlint: {len(new)} new, {pinned} pinned "
+                   f"({len(lint_scope)} changed files vs "
+                   f"{args.changed}; {dt:.2f}s)")
+        else:
+            msg = (f"graftlint: {len(new)} new, {pinned} pinned, "
+                   f"{len(fixed)} baseline entries now fixed "
+                   f"({dt:.2f}s)")
+            if fixed:
+                msg += " — shrink the pin file with --write-baseline"
         print(msg)
     return 1 if new else 0
 

@@ -43,9 +43,19 @@ RULES = {
             "values, declared domains hold",
     "GL11": "twin discipline: device-dispatched kernels need a twin, "
             "a parity test and a provable padding guard",
+    "GL12": "dispatch discipline: no jax compile/dispatch, ops "
+            "excursion or unbounded blocking reachable on a "
+            "latency-critical thread role outside device._guarded",
+    "GL13": "wire-taint budgets: untrusted decode counts must pass a "
+            "dominating remaining-budget check before bounding a "
+            "loop, allocation or size multiplication",
+    "GL14": "watchdog coverage: every spawned long-lived loop "
+            "declares a thread-role, registers a health.Heartbeat "
+            "and beats it",
 }
 INTERPROC_RULES = {"GL05", "GL06", "GL07", "GL08"}
 KERNEL_RULES = {"GL09", "GL10", "GL11"}
+THREADROLE_RULES = {"GL12", "GL13", "GL14"}
 
 # -- rule scoping over harmony_tpu/ -----------------------------------------
 
@@ -64,6 +74,17 @@ _GL04_PREFIXES = (
     "harmony_tpu/consensus/", "harmony_tpu/node/", "harmony_tpu/chain/",
     "harmony_tpu/ops/", "harmony_tpu/ref/",
 )
+# GL13's trust boundary: the modules that decode wire/disk bytes an
+# adversary (or a torn write) controls — see threadrole.py's docstring
+_GL13_FILES = {
+    "harmony_tpu/consensus/messages.py",
+    "harmony_tpu/consensus/view_change.py",
+    "harmony_tpu/p2p/stream.py",
+    "harmony_tpu/sidecar/protocol.py",
+    "harmony_tpu/staking/slash.py",
+    "harmony_tpu/core/rawdb.py",
+    "harmony_tpu/core/types.py",
+}
 _GL04_FILES = {
     "harmony_tpu/bls.py", "harmony_tpu/multibls.py",
     "harmony_tpu/crypto_bn256.py", "harmony_tpu/crypto_ecdsa.py",
@@ -93,6 +114,11 @@ def _rule_applies(rule: str, relpath: str) -> bool:
     if rule in KERNEL_RULES:
         # kernelcheck self-limits to modules carrying a
         # ``# graftlint: kernel-module`` contract
+        return True
+    if rule == "GL13":
+        return relpath in _GL13_FILES
+    if rule in THREADROLE_RULES:
+        # GL12/GL14 self-limit to annotated spawn sites and role cones
         return True
     return False
 
@@ -191,7 +217,7 @@ def _interproc_findings(sources: dict, supps: dict,
     """Whole-program pass over {relpath: (source, tree)}."""
     from . import interproc as IP
 
-    whole = INTERPROC_RULES | KERNEL_RULES
+    whole = INTERPROC_RULES | KERNEL_RULES | THREADROLE_RULES
     wanted = whole if only_rules is None else whole & only_rules
     if not wanted and program_out is None:
         return []
@@ -211,6 +237,11 @@ def _interproc_findings(sources: dict, supps: dict,
         from . import kernelcheck as KC
 
         raw += [f for f in KC.kernel_findings(prog)
+                if f.rule in wanted]
+    if wanted & THREADROLE_RULES:
+        from . import threadrole as TR
+
+        raw += [f for f in TR.threadrole_findings(prog)
                 if f.rule in wanted]
     findings = []
     for sf in raw:
@@ -270,15 +301,28 @@ _TESTS_OVERRIDE_RE = re.compile(
 def _aux_inputs_sha(texts: dict) -> list[tuple[str, str]]:
     """Non-linted inputs whole-program rules read from disk (GL11's
     parity-test scan of tests/*.py, plus any ``tests=`` override dir a
-    kernel-module annotation names) — they must key the cache too."""
+    kernel-module annotation names) — they must key the cache too.
+    The committed baseline rides along for the same reason: a pin edit
+    must never answer from a verdict cached against the old pins
+    (inline ``# graftlint: disable=`` pins are already covered — they
+    live in the linted files and therefore in the file shas)."""
     from . import cache as CA
+
+    out = []
+    try:
+        out.append((
+            "aux:" + DEFAULT_BASELINE_PATH.as_posix(),
+            CA.file_sha(
+                DEFAULT_BASELINE_PATH.read_text(encoding="utf-8")),
+        ))
+    except OSError:
+        pass  # no baseline yet: its absence is keyed by the empty list
 
     roots = {REPO_ROOT / "tests"}
     for src in texts.values():
         for m in _TESTS_OVERRIDE_RE.finditer(src):
             if m.group(1) != "skip":
                 roots.add(REPO_ROOT / m.group(1))
-    out = []
     for root in sorted(roots, key=str):
         if not root.is_dir():
             continue

@@ -1,0 +1,732 @@
+"""Thread-role & trust-boundary pass: GL12/GL13/GL14.
+
+The three most expensive bug classes of the robustness arc were found
+*dynamically* — chaos caught ``Mask.aggregate_public`` compiling XLA on
+the consensus pump thread (a ~90 s wedge of every validator), the wire
+fuzzer forced hand-hardening of every length-prefixed decoder after a
+forged count turned into a 4-billion-iteration loop, and the watchdog
+only protects threads that remembered to register a Heartbeat.  This
+module turns each convention into a checked invariant:
+
+GL12 — dispatch discipline over a **thread-role-annotated call graph**.
+Spawn sites declare their thread's role with an inline annotation on
+the ``threading.Thread(...)`` call::
+
+    t = threading.Thread(  # graftlint: thread-role=consensus.pump
+        target=loop, daemon=True)
+
+From every annotated spawn the pass BFS-reaches over an *extended*
+call graph (interproc.Program's edges plus nested ``def``s, which the
+main graph deliberately skips) and flags, outside the sanctioned
+dispatch layer (device.py / aot.py / ops/ / sched/ / parallel/):
+
+- a jax compile/dispatch head (``jax.jit``, ``jnp.*``, a device-module
+  op, an AOT load, a device.py factory) reachable on a
+  **latency-critical** role — the exact aggregate_public wedge class.
+  Work routed through ``device._guarded`` lives in nested ``dispatch()``
+  closures that are *passed*, never called, so the guarded path is
+  naturally invisible to the reachability — only inline device work
+  lights up;
+- an ``ops.*`` device excursion reachable on ANY role — under
+  ``HARMONY_KERNEL_TWIN=1`` jax is UNLOADED by contract, so a thread
+  touching the ops layer directly crashes exactly when the twin
+  config is exercised;
+- unbounded blocking (``.wait()`` / ``.join()`` with no timeout)
+  reachable on a latency-critical role.
+
+GL13 — wire-taint budgets.  Intra-procedural taint from trust-boundary
+decode sources (``int.from_bytes``, ``struct.unpack*``, a Reader's
+``.int_()``) to loop bounds (``range``), allocations (``bytes``/
+``bytearray``), and size multiplications.  A taint is sanitized by a
+*dominating* (earlier, same function) comparison naming it inside an
+``if``/``assert`` test — the remaining-budget idiom every hardened
+decoder uses — or by a clean rebind through ``min()`` / a Reader's
+``.checked_count()``.  Scope (engine._rule_applies): the trust-boundary
+modules only — consensus/messages.py, consensus/view_change.py,
+p2p/stream.py, sidecar/protocol.py, staking/slash.py, core/rawdb.py,
+core/types.py.
+
+GL14 — watchdog coverage.  Every spawned **long-lived** loop (the
+resolved thread target's own body contains a ``while``) must declare a
+thread-role, and — where the role's policy demands it — register a
+``health.Heartbeat`` (at the spawn site, anywhere in the spawning
+class, or in the loop itself) and transitively reach ``beat()`` /
+``idle()`` from its body.  ``transient`` declares a bounded lifetime
+(scenario drivers, per-connection handlers that loop); ``serving``
+and ``watchdog`` are heartbeat-exempt by policy (the serving plane is
+covered by readiness probes; the watchdog cannot watch itself).
+
+All findings are SiteFindings: witness call chains ride in ``detail``
+(display-only), fingerprints stay line-free, and the baseline / inline
+pins / SARIF / cache plumbing applies unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .interproc import (
+    _COMMON_METHODS,
+    _FuncDef,
+    Program,
+    SiteFinding,
+    _self_attr,
+    _short,
+)
+from .rules import dotted_name
+
+# -- role registry -----------------------------------------------------------
+
+# role -> policy.  latency_critical: the thread sits on the consensus
+# critical path and must never compile, dispatch or block unboundedly.
+# heartbeat: the PR-14 watchdog contract applies (register + beat/idle).
+ROLE_POLICY = {
+    "consensus.pump":   {"latency_critical": True,  "heartbeat": True},
+    "sched.flush":      {"latency_critical": True,  "heartbeat": True},
+    "sidecar.reader":   {"latency_critical": False, "heartbeat": True},
+    "governor.sampler": {"latency_critical": False, "heartbeat": True},
+    "netem.scheduler":  {"latency_critical": False, "heartbeat": True},
+    "watchdog":         {"latency_critical": False, "heartbeat": False},
+    # the union label for the general serving plane (rpc, metrics,
+    # explorer, discovery, accept loops): long-lived but off the
+    # consensus critical path; covered by /readyz, not per-thread beats
+    "serving":          {"latency_critical": False, "heartbeat": False},
+    # declared bounded lifetime: joined by a scenario / request scope
+    "transient":        {"latency_critical": False, "heartbeat": False},
+}
+
+_ROLE_RE = re.compile(r"graftlint:\s*thread-role=([A-Za-z0-9_.\-]+)")
+
+# the sanctioned device-dispatch layer: these files ARE the guarded
+# path (plus the kernel programs themselves and the submission layer)
+_SANCTIONED_FILES = {"harmony_tpu/device.py", "harmony_tpu/aot.py"}
+_SANCTIONED_PREFIXES = (
+    "harmony_tpu/ops/", "harmony_tpu/sched/", "harmony_tpu/parallel/",
+)
+
+
+def _sanctioned(relpath: str) -> bool:
+    return (relpath in _SANCTIONED_FILES
+            or relpath.startswith(_SANCTIONED_PREFIXES))
+
+
+# -- extended function index (nested defs included) --------------------------
+
+
+@dataclass
+class XFunc:
+    """One function *or nested def* with the facts GL12/GL14 consume."""
+    fid: str
+    relpath: str
+    qualname: str
+    cls: str | None
+    node: ast.AST
+    parent: "XFunc | None"
+    nested: dict = field(default_factory=dict)   # name -> fid
+    edges: set = field(default_factory=set)      # callee fids
+    while_lines: list = field(default_factory=list)
+    # (line, col, desc, clause) — clause "compile" | "ops"
+    device_ops: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)  # (line, col, desc)
+    beats: bool = False
+    registers: bool = False
+    spawns: list = field(default_factory=list)   # [ast.Call]
+
+
+class _Index:
+    """interproc.Program's call graph, extended with nested defs (the
+    main graph skips them on purpose — its lock/holds semantics are
+    lexical — but a thread *target* is usually a nested ``loop()``)."""
+
+    def __init__(self, prog: Program):
+        self.prog = prog
+        self.funcs: dict[str, XFunc] = {}
+        for relpath in sorted(prog.modules):
+            mi = prog.modules[relpath]
+            for node in mi.tree.body:
+                if isinstance(node, _FuncDef):
+                    self._add(mi, node, node.name, None, None)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, _FuncDef):
+                            self._add(mi, item,
+                                      f"{node.name}.{item.name}",
+                                      node.name, None)
+        # a class "registers a heartbeat" when any of its methods does
+        # (start() registers, _revive() respawns — same participant)
+        self._class_registers: set = set()
+        for xf in self.funcs.values():
+            if xf.registers and xf.cls:
+                self._class_registers.add((xf.relpath, xf.cls))
+
+    def _add(self, mi, node, qual, cls, parent):
+        fid = f"{mi.relpath}::{qual}"
+        xf = XFunc(fid, mi.relpath, qual, cls, node, parent)
+        self.funcs[fid] = xf
+        if parent is not None:
+            parent.nested[node.name] = fid
+        for child in _own_nodes(node):
+            if isinstance(child, ast.While):
+                xf.while_lines.append(child.lineno)
+            elif isinstance(child, ast.Call):
+                self._classify(mi, xf, child)
+        for d in _child_defs(node):
+            self._add(mi, d, f"{qual}.<locals>.{d.name}", cls, xf)
+        # edges resolve lazily (nested siblings must be indexed first)
+
+    def finalize(self):
+        for xf in self.funcs.values():
+            mi = self.prog.modules[xf.relpath]
+            for node in _own_nodes(xf.node):
+                if isinstance(node, ast.Call):
+                    xf.edges.update(self._resolve_call(mi, xf, node))
+
+    # -- per-call classification -------------------------------------------
+
+    def _classify(self, mi, xf: XFunc, node: ast.Call):
+        head = dotted_name(node.func)
+        if head and head.split(".")[-1] == "Thread":
+            if any(k.arg == "target" for k in node.keywords):
+                xf.spawns.append(node)
+        if _is_health_register(head, mi):
+            xf.registers = True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("beat", "idle"):
+                xf.beats = True
+            if node.func.attr in ("wait", "join") \
+                    and not node.args and not node.keywords:
+                xf.blocking.append((
+                    node.lineno, node.col_offset,
+                    f"unbounded .{node.func.attr}()"))
+        clause = _device_clause(head, mi, self.prog)
+        if clause:
+            xf.device_ops.append((
+                node.lineno, node.col_offset, head, clause))
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve_call(self, mi, xf: XFunc, node: ast.Call) -> list:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self._resolve_name(mi, xf, f.id)
+        if isinstance(f, ast.Attribute):
+            if _self_attr(f) is not None and xf.cls:
+                cls = mi.classes.get(xf.cls)
+                if cls and f.attr in cls["methods"]:
+                    return [cls["methods"][f.attr]]
+                return []
+            base = dotted_name(f.value)
+            if base and base in mi.mod_imports:
+                tgt = self.prog.modules.get(mi.mod_imports[base])
+                if tgt and f.attr in tgt.functions:
+                    return [tgt.functions[f.attr]]
+                return []
+            meth = f.attr
+            if meth in _COMMON_METHODS or len(meth) <= 3:
+                return []
+            cands = self.prog._method_index.get(meth, [])
+            return list(cands) if len(cands) == 1 else []
+        return []
+
+    def _resolve_name(self, mi, xf: XFunc, name: str) -> list:
+        p = xf
+        while p is not None:  # lexical chain: own + enclosing nesteds
+            if name in p.nested:
+                return [p.nested[name]]
+            p = p.parent
+        if name in mi.functions:
+            return [mi.functions[name]]
+        if name in mi.name_imports:
+            modpath, orig = mi.name_imports[name]
+            tgt = self.prog.modules.get(modpath)
+            if tgt and orig in tgt.functions:
+                return [tgt.functions[orig]]
+        return []
+
+    def resolve_target(self, mi, xf: XFunc, expr) -> str | None:
+        """The thread target's fid, or None (stdlib serve_forever,
+        bound methods of foreign objects, lambdas: not analyzable)."""
+        if isinstance(expr, ast.Name):
+            got = self._resolve_name(mi, xf, expr.id)
+            return got[0] if got else None
+        if isinstance(expr, ast.Attribute) and _self_attr(expr) \
+                is not None and xf.cls:
+            cls = mi.classes.get(xf.cls)
+            if cls and expr.attr in cls["methods"]:
+                return cls["methods"][expr.attr]
+        return None
+
+    def reach(self, start: str) -> dict[str, str]:
+        """fid -> witness chain ("" for the start) via BFS."""
+        chains = {start: ""}
+        queue = [start]
+        while queue:
+            cur = queue.pop(0)
+            xf = self.funcs.get(cur)
+            if xf is None:
+                continue
+            base = chains[cur]
+            for nxt in sorted(xf.edges):
+                if nxt in chains or nxt not in self.funcs:
+                    continue
+                chains[nxt] = (base + " -> " if base else "") \
+                    + _short(nxt)
+                queue.append(nxt)
+        return chains
+
+    def spawner_registers(self, xf: XFunc) -> bool:
+        p = xf
+        while p is not None:
+            if p.registers:
+                return True
+            p = p.parent
+        return (xf.relpath, xf.cls) in self._class_registers \
+            if xf.cls else False
+
+
+def _own_nodes(fn):
+    """Every AST node of ``fn``'s body, nested defs excluded (they are
+    their own XFuncs)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FuncDef) or isinstance(n, ast.ClassDef):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _child_defs(fn):
+    """Defs nested directly under ``fn`` (inside ifs/trys included,
+    inside deeper defs excluded)."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FuncDef):
+            out.append(n)
+            continue
+        if isinstance(n, ast.ClassDef):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return sorted(out, key=lambda d: d.lineno)
+
+
+def _is_health_register(head: str | None, mi) -> bool:
+    if not head:
+        return False
+    parts = head.split(".")
+    if parts[-1] != "register":
+        return False
+    if len(parts) == 1:
+        tgt = mi.name_imports.get("register")
+        return bool(tgt and str(tgt[0]).endswith("health.py"))
+    root = parts[0]
+    if root == "health":
+        return True
+    tgt = mi.mod_imports.get(root)
+    return isinstance(tgt, str) and tgt.endswith("health.py")
+
+
+def _device_clause(head: str | None, mi, prog) -> str | None:
+    """"compile" for a jax compile/dispatch head, "ops" for a call into
+    an ops device module (interop.py excluded: host-side converters)."""
+    if not head:
+        return None
+    from .interproc import _is_device_head
+
+    root = head.split(".")[0]
+    if root in ("jnp",) or _is_device_head(head, mi, prog):
+        return "compile"
+    tgt = mi.mod_imports.get(root)
+    if not isinstance(tgt, str) and root in mi.name_imports:
+        tgt = mi.name_imports[root][0]
+    if isinstance(tgt, str):
+        # unresolved imports fall back to the dotted module NAME
+        # (single-file lint can't see sibling files): normalize both
+        norm = tgt if tgt.endswith(".py") \
+            else tgt.replace(".", "/") + ".py"
+        if norm.startswith("harmony_tpu/ops/") \
+                and not norm.endswith("interop.py"):
+            return "ops"
+    return None
+
+
+# -- roles at spawn sites ----------------------------------------------------
+
+
+def _role_annotations(source: str) -> dict[int, str]:
+    out = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _ROLE_RE.search(line)
+        if m:
+            out[lineno] = m.group(1)
+    return out
+
+
+def _spawn_role(spawn: ast.Call, roles: dict[int, str]) -> str | None:
+    end = getattr(spawn, "end_lineno", spawn.lineno) or spawn.lineno
+    for ln in range(spawn.lineno, end + 1):
+        if ln in roles:
+            return roles[ln]
+    return None
+
+
+# -- GL12 + GL14 -------------------------------------------------------------
+
+
+def _gl12_gl14(prog: Program) -> list[SiteFinding]:
+    idx = _Index(prog)
+    idx.finalize()
+    out: list[SiteFinding] = []
+    seen_gl12: set = set()
+
+    spawners = sorted(
+        idx.funcs.values(), key=lambda x: (x.relpath, x.qualname))
+    for xf in spawners:
+        if not xf.spawns:
+            continue
+        mi = prog.modules[xf.relpath]
+        roles = _role_annotations(mi.source)
+        for spawn in sorted(xf.spawns, key=lambda s: s.lineno):
+            role = _spawn_role(spawn, roles)
+            if role is not None and role not in ROLE_POLICY:
+                out.append(SiteFinding(
+                    xf.relpath, "GL14", spawn.lineno, spawn.col_offset,
+                    f"unknown thread-role '{role}' (registry: "
+                    + ", ".join(sorted(ROLE_POLICY)) + ")",
+                    xf.qualname))
+                continue
+            target = next(k.value for k in spawn.keywords
+                          if k.arg == "target")
+            tfid = idx.resolve_target(mi, xf, target)
+            tgt = idx.funcs.get(tfid) if tfid else None
+            if tgt is None or not tgt.while_lines:
+                continue  # bounded / not statically analyzable
+            if role is None:
+                out.append(SiteFinding(
+                    xf.relpath, "GL14", spawn.lineno, spawn.col_offset,
+                    "long-lived thread loop spawned without a declared "
+                    "thread-role (annotate the Thread(...) call: "
+                    "# graftlint: thread-role=<role>)",
+                    xf.qualname,
+                    f"target {_short(tfid)} loops at line "
+                    f"{tgt.while_lines[0]}"))
+                continue
+            policy = ROLE_POLICY[role]
+            chains = idx.reach(tfid)
+            if policy["heartbeat"]:
+                reg_ok = idx.spawner_registers(xf) or any(
+                    idx.funcs[f].registers for f in chains)
+                beat_ok = any(idx.funcs[f].beats for f in chains)
+                if not reg_ok:
+                    out.append(SiteFinding(
+                        xf.relpath, "GL14", spawn.lineno,
+                        spawn.col_offset,
+                        f"{role} thread never registers a "
+                        "health.Heartbeat (the watchdog cannot see it "
+                        "wedge)", xf.qualname,
+                        f"target {_short(tfid)}"))
+                elif not beat_ok:
+                    out.append(SiteFinding(
+                        xf.relpath, "GL14", spawn.lineno,
+                        spawn.col_offset,
+                        f"{role} loop never reaches Heartbeat.beat()/"
+                        "idle() (registered but silent = permanently "
+                        "stale)", xf.qualname,
+                        f"target {_short(tfid)}"))
+            # GL12: role-cone dispatch discipline
+            for fid in sorted(chains):
+                rxf = idx.funcs[fid]
+                if _sanctioned(rxf.relpath):
+                    continue
+                via = chains[fid]
+                witness = _short(tfid) + (f" -> {via}" if via else "")
+                for line, col, desc, clause in rxf.device_ops:
+                    key = (rxf.relpath, line, col, clause)
+                    if key in seen_gl12:
+                        continue
+                    if clause == "compile":
+                        if not policy["latency_critical"]:
+                            continue
+                        msg = (f"jax compile/dispatch {desc} reachable "
+                               f"on the {role} thread outside "
+                               "device._guarded (the aggregate_public "
+                               "wedge class: first-shape XLA compile "
+                               "stalls the round)")
+                    else:
+                        msg = (f"ops device excursion {desc} reachable "
+                               f"on the {role} thread (twin mode keeps "
+                               "jax unloaded; route it through "
+                               "device.py's guarded dispatch)")
+                    seen_gl12.add(key)
+                    out.append(SiteFinding(
+                        rxf.relpath, "GL12", line, col, msg,
+                        rxf.qualname, witness))
+                if policy["latency_critical"]:
+                    for line, col, desc in rxf.blocking:
+                        key = (rxf.relpath, line, col, "block")
+                        if key in seen_gl12:
+                            continue
+                        seen_gl12.add(key)
+                        out.append(SiteFinding(
+                            rxf.relpath, "GL12", line, col,
+                            f"{desc} reachable on the {role} thread "
+                            "(a latency-critical role may only block "
+                            "with a timeout)", rxf.qualname, witness))
+    return out
+
+
+# -- GL13: wire-taint budgets ------------------------------------------------
+
+_CLEAN_HEADS = {"min", "len", "_checked_count"}
+_CLEAN_ATTRS = {"checked_count"}
+_SOURCE_ATTRS = {"int_"}
+
+
+def _is_source_call(node: ast.AST) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    head = dotted_name(node.func)
+    if head == "int.from_bytes":
+        return "int.from_bytes"
+    if head and head.split(".")[-1] in ("unpack", "unpack_from") \
+            and head.split(".")[0] == "struct":
+        return head
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _SOURCE_ATTRS:
+        return f".{node.func.attr}()"
+    return None
+
+
+def _is_clean_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    head = dotted_name(node.func)
+    if head in _CLEAN_HEADS:
+        return True
+    return isinstance(node.func, ast.Attribute) \
+        and node.func.attr in _CLEAN_ATTRS
+
+
+def _expr_taint(expr, tainted: dict) -> str | None:
+    """The source description when ``expr`` carries taint, else None.
+    A clean call (min / checked_count / len) launders everything under
+    it — that IS the sanctioner idiom.  A non-source helper call stops
+    the descent too: ``lookup(db, n)`` with tainted ``n`` returns
+    whatever the helper returns, not an attacker-sized integer, and
+    a subscript is clamped by the sequence it indexes."""
+    if _is_clean_call(expr):
+        return None
+    src = _is_source_call(expr)
+    if src:
+        return src
+    if isinstance(expr, (ast.Call, ast.Subscript)):
+        return None
+    if isinstance(expr, ast.Name) and expr.id in tainted:
+        return tainted[expr.id][1]
+    for child in ast.iter_child_nodes(expr):
+        got = _expr_taint(child, tainted)
+        if got:
+            return got
+    return None
+
+
+def _iter_stmts(body):
+    for s in body:
+        yield s
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(s, attr, None)
+            if sub:
+                yield from _iter_stmts(
+                    [x for x in sub if not isinstance(x, _FuncDef)])
+        for h in getattr(s, "handlers", []):
+            yield from _iter_stmts(h.body)
+
+
+def _gl13_function(fn, relpath: str, qualname: str) -> list[SiteFinding]:
+    out: list[SiteFinding] = []
+    tainted: dict[str, tuple[int, str]] = {}  # name -> (line, source)
+    guards: dict[str, list[int]] = {}         # name -> [guard lines]
+
+    def guarded(name: str, sink_line: int) -> bool:
+        src_line = tainted[name][0]
+        return any(src_line < g <= sink_line
+                   for g in guards.get(name, ()))
+
+    def names_in(expr):
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    def int_taint(expr) -> tuple[str | None, list]:
+        """(direct source, tainted names) of an *integer-valued* size
+        expression.  Only arithmetic is traversed: a tainted name
+        buried inside a helper call (``self._take(ln)``) or a slice
+        (``view[off:off+n]``) is length-clamped by that construct, not
+        an n-sized cost."""
+        if isinstance(expr, ast.Name):
+            return None, ([expr.id] if expr.id in tainted else [])
+        src = _is_source_call(expr)
+        if src:
+            return src, []
+        if isinstance(expr, ast.BinOp):
+            ls, ln_ = int_taint(expr.left)
+            rs, rn = int_taint(expr.right)
+            return ls or rs, ln_ + rn
+        if isinstance(expr, ast.UnaryOp):
+            return int_taint(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            bs, bn = int_taint(expr.body)
+            os_, on = int_taint(expr.orelse)
+            return bs or os_, bn + on
+        return None, []
+
+    def check_bound(expr, line, col, what):
+        """Flag ``expr`` used as ``what`` when tainted & unguarded."""
+        direct, names = int_taint(expr)
+        hot = [n for n in names if not guarded(n, line)]
+        if direct:
+            out.append(SiteFinding(
+                relpath, "GL13", line, col,
+                f"untrusted count from {direct} feeds {what} with no "
+                "remaining-budget check (a forged prefix buys "
+                "attacker-priced work)", qualname))
+        elif hot:
+            n = sorted(hot)[0]
+            out.append(SiteFinding(
+                relpath, "GL13", line, col,
+                f"untrusted count feeds {what} with no dominating "
+                "remaining-budget comparison (tainted from "
+                f"{tainted[n][1]})", qualname,
+                f"'{n}' tainted at line {tainted[n][0]}"))
+
+    def range_bound(node: ast.Call):
+        """The expression that sizes the iteration.  ``range(a, a+n)``
+        iterates n times regardless of a — peel the shared base so a
+        tainted *offset* with a clamped *count* stays clean."""
+        if len(node.args) < 2:
+            return node.args[0]
+        bound = node.args[1]
+        if isinstance(bound, ast.BinOp) and isinstance(bound.op, ast.Add):
+            base = ast.dump(node.args[0])
+            if ast.dump(bound.left) == base:
+                return bound.right
+            if ast.dump(bound.right) == base:
+                return bound.left
+        return bound
+
+    def _is_sequence(expr) -> bool:
+        return (isinstance(expr, ast.Constant)
+                and isinstance(expr.value, (str, bytes))) \
+            or isinstance(expr, (ast.List, ast.Tuple))
+
+    def scan_sinks(stmt):
+        """Sinks in this statement's OWN expressions (nested statement
+        bodies are scanned at their own _iter_stmts visit, with the
+        taint state of that point)."""
+        exprs = []
+        for _, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                exprs.append(value)
+            elif isinstance(value, list):
+                exprs.extend(v for v in value
+                             if isinstance(v, ast.expr))
+        stack = [(e, False) for e in exprs]
+        while stack:
+            node, in_cmp = stack.pop()
+            if isinstance(node, _FuncDef):
+                continue
+            if isinstance(node, ast.Compare):
+                in_cmp = True
+            if isinstance(node, ast.Call):
+                head = dotted_name(node.func)
+                if head == "range" and node.args:
+                    check_bound(range_bound(node), node.lineno,
+                                node.col_offset, "a range() bound")
+                elif head in ("bytes", "bytearray") \
+                        and len(node.args) == 1:
+                    check_bound(node.args[0], node.lineno,
+                                node.col_offset, "an allocation size")
+            # sequence repeat: b"\x00" * n allocates n bytes outright
+            # (plain integer arithmetic is cheap — it only becomes a
+            # cost at the range/allocation it later feeds, where the
+            # taint it carries is checked instead)
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mult) and not in_cmp:
+                sides = ((node.left, node.right),
+                         (node.right, node.left))
+                for seq, cnt in sides:
+                    if not _is_sequence(seq):
+                        continue
+                    direct, names = int_taint(cnt)
+                    hot = [n for n in names
+                           if not guarded(n, node.lineno)]
+                    if direct or hot:
+                        why = direct or tainted[sorted(hot)[0]][1]
+                        out.append(SiteFinding(
+                            relpath, "GL13", node.lineno,
+                            node.col_offset,
+                            "untrusted count sizes a sequence "
+                            "repeat with no dominating remaining-"
+                            f"budget comparison (tainted from {why})",
+                            qualname))
+                        break
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, in_cmp))
+
+    for stmt in _iter_stmts(
+            [s for s in fn.body if not isinstance(s, _FuncDef)]):
+        # guards first: `if n > budget: raise` guards the body it owns
+        if isinstance(stmt, (ast.If, ast.Assert, ast.IfExp)):
+            for cmp_node in ast.walk(stmt.test):
+                if isinstance(cmp_node, ast.Compare):
+                    for name in names_in(cmp_node):
+                        guards.setdefault(name, []).append(
+                            stmt.test.lineno)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            if value is not None:
+                src = _expr_taint(value, tainted)
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        names = [tgt]
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        names = [e for e in tgt.elts
+                                 if isinstance(e, ast.Name)]
+                    else:
+                        names = []  # subscript/attr stores: no rebind
+                    for nm in names:
+                        if src:
+                            tainted.setdefault(
+                                nm.id, (stmt.lineno, src))
+                        elif not isinstance(stmt, ast.AugAssign):
+                            tainted.pop(nm.id, None)
+        scan_sinks(stmt)
+    return out
+
+
+def gl13_findings(prog: Program) -> list[SiteFinding]:
+    out = []
+    for relpath in sorted(prog.modules):
+        mi = prog.modules[relpath]
+
+        def visit(node, qual_prefix=""):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FuncDef):
+                    qual = qual_prefix + child.name
+                    out.extend(_gl13_function(child, relpath, qual))
+                    visit(child, qual + ".<locals>.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, qual_prefix + child.name + ".")
+                else:
+                    visit(child, qual_prefix)
+
+        visit(mi.tree)
+    return out
+
+
+def threadrole_findings(prog: Program) -> list[SiteFinding]:
+    return _gl12_gl14(prog) + gl13_findings(prog)
