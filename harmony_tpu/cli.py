@@ -95,6 +95,12 @@ DEFAULTS = {
     "trace_sample": 1.0,   # root-span sampling rate [0, 1]
     "trace_slo": None,     # round-latency SLO seconds (None = off)
     "trace_dir": None,     # dump dir ($HARMONY_TPU_TRACE_DIR/<tmp>)
+    # startup AOT warmup: precompile every compile-manifest program
+    # (GL16's machine-checked shape set) before the node serves, so no
+    # serving path ever pays a first-use XLA compile (the PR-15
+    # NEWVIEW wedge).  False only for throwaway dev runs that accept
+    # first-use compile stalls
+    "aot_warmup": True,
 }
 
 
@@ -680,6 +686,14 @@ def main(argv=None):
         if offset is None:
             print("warning: NTP unreachable, clock check skipped",
                   flush=True)
+
+    # warm the compile surface BEFORE any service thread can reach a
+    # device dispatch: after this, every manifest program is a cache
+    # hit and the consensus pump never blocks on XLA
+    if cfg.get("aot_warmup", True):
+        from . import aot
+
+        aot.startup_warmup()
 
     node, manager, reg, rpc, metrics = build_node(cfg)
     manager.start_services()

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 import time
 
+from . import aot
 from . import faultinject as FI
 from . import prof
 from . import trace
@@ -66,6 +67,15 @@ def _program_first_use(program: str) -> bool:
             _SEEN_PROGRAMS.add(program)
     JIT.inc("miss" if first else "hit")
     return first
+
+
+def mark_warm(program: str) -> None:
+    """aot.warmup's hook: record ``program`` as already compiled (or
+    twin-wired) so serving-path dispatches account a warm cache instead
+    of paying a first-use compile.  No JIT counter movement — warmup is
+    neither a hit nor a serving-path miss."""
+    with _SEEN_LOCK:
+        _SEEN_PROGRAMS.add(program)
 
 # The device-dispatch circuit breaker: a backend that keeps raising (a
 # wedged accelerator tunnel, a dying sidecar of the twin kernels, an
@@ -136,12 +146,21 @@ def _guarded(kind: str, dispatch, fallback):
 COMMITTEE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
 
+# graftlint: bucket-fn registry=COMMITTEE_BUCKETS
 def committee_bucket(n: int) -> int:
+    """Smallest pinned bucket admitting ``n`` committee slots.  Widths
+    past the largest bucket raise instead of minting an unbounded
+    program-shape family (the old round-up tail was exactly the
+    NEWVIEW-wedge class GL15 now rejects): no deployed committee
+    exceeds 1024 slots, and admitting one is a REGISTRY change —
+    extend COMMITTEE_BUCKETS so the warmup manifest precompiles it."""
     for b in COMMITTEE_BUCKETS:
         if n <= b:
             return b
-    return ((n + COMMITTEE_BUCKETS[-1] - 1)
-            // COMMITTEE_BUCKETS[-1]) * COMMITTEE_BUCKETS[-1]
+    raise ValueError(
+        f"committee width {n} exceeds the largest pinned bucket "
+        f"{COMMITTEE_BUCKETS[-1]}; extend COMMITTEE_BUCKETS (and "
+        f"regenerate the compile manifest) to admit it")
 
 
 class CommitteeTable:
@@ -290,6 +309,14 @@ def _kernels():
     return OB
 
 
+# The jit factories hold ONE jitted callable each; per-dispatch program
+# selection (warmed AOT executable vs. shipped jaxexport artifact vs.
+# plain jit) happens at the call sites through ``aot.resolve(program)``
+# — the program NAME computed there is the single source of truth, so
+# the compile-surface analysis (GL15) can derive every shape from the
+# pinned bucket registries instead of chasing runtime ``.shape[0]``s.
+
+
 def _get_verify_fn():
     global _verify_fn
     if kernel_twin_active():
@@ -299,19 +326,7 @@ def _get_verify_fn():
 
         from .ops import bls as OB
 
-        jitted = jax.jit(OB.verify)
-
-        def dispatch(pk, hh, sg):
-            if jax.default_backend() != "cpu":
-                from . import aot
-
-                call = aot.load(f"verify_w{pk.shape[0]}")
-                if call is not None:
-                    return call(pk, hh, sg)
-            return jitted(pk, hh, sg)
-
-        dispatch._jitted = jitted  # prof cost-analysis target
-        _verify_fn = dispatch
+        _verify_fn = jax.jit(OB.verify)
     return _verify_fn
 
 
@@ -324,21 +339,7 @@ def _get_agg_verify_fn():
 
         from .ops import bls as OB
 
-        jitted = jax.jit(OB.agg_verify)
-
-        def dispatch(tbl, bits, h, sig):
-            # accelerator: prefer the AOT artifact for this bucket so
-            # first contact compiles from the shipped lowering
-            if jax.default_backend() != "cpu":
-                from . import aot
-
-                call = aot.load(f"agg_verify_b{tbl.shape[0]}")
-                if call is not None:
-                    return call(tbl, bits, h, sig)
-            return jitted(tbl, bits, h, sig)
-
-        dispatch._jitted = jitted  # prof cost-analysis target
-        _agg_verify_fn = dispatch
+        _agg_verify_fn = jax.jit(OB.agg_verify)
     return _agg_verify_fn
 
 
@@ -351,22 +352,26 @@ def _get_agg_verify_batch_fn():
 
         from .ops import bls as OB
 
-        jitted = jax.jit(OB.agg_verify_batch)
-
-        def dispatch(tbl, bm, hh, sg):
-            if jax.default_backend() != "cpu":
-                from . import aot
-
-                call = aot.load(
-                    f"agg_verify_batch_b{tbl.shape[0]}x{bm.shape[0]}"
-                )
-                if call is not None:
-                    return call(tbl, bm, hh, sg)
-            return jitted(tbl, bm, hh, sg)
-
-        dispatch._jitted = jitted  # prof cost-analysis target
-        _agg_verify_batch_fn = dispatch
+        _agg_verify_batch_fn = jax.jit(OB.agg_verify_batch)
     return _agg_verify_batch_fn
+
+
+_masked_sum_fn = None
+
+
+def _get_masked_sum_fn():
+    """One jitted masked tree-sum per process (shapes bucketed by the
+    committee registry) — the fused path for accelerators.  The CPU
+    route keeps the eager ops (same rationale as ``_fused``)."""
+    global _masked_sum_fn
+    if _masked_sum_fn is None:
+        import jax
+
+        from .ops import curve as CV
+
+        _masked_sum_fn = jax.jit(
+            lambda pks, bm: CV.masked_sum(pks, bm, CV.FP_OPS))
+    return _masked_sum_fn
 
 
 def _fused() -> bool:
@@ -444,6 +449,10 @@ def agg_verify_hashed_on_device(table: CommitteeTable, bits, h_point,
         sg = np.asarray(I.g2_affine_to_arr(sig_point))
         TRANSFER.inc("h2d", bm.nbytes + hh.nbytes + sg.nbytes)
         program = f"agg_verify_b{table.size}"
+        if fused and not kernel_twin_active():
+            warm = aot.resolve(program)
+            if warm is not None:
+                fn = warm
         first = _program_first_use(program) if fused else False
         t0 = time.monotonic()
         call_args = (
@@ -500,25 +509,45 @@ def masked_pubkey_sum(points, bits, fallback, cache=None):
         from .ops import curve as CV
         from .ops import interop as I
 
+        # pad mask and points to the committee bucket: one compiled
+        # masked-sum program per PINNED width instead of one per mask
+        # width (the PR-15 wedge minted a fresh program at every new
+        # committee size).  Pad lanes carry zero bits, so the tree sum
+        # selects infinity for them regardless of the pad values.
+        width = committee_bucket(len(points))
         pks = cache[0] if cache is not None else None
         if pks is None:
-            pks = jnp.asarray(np.stack(
-                [I.g1_affine_to_jacobian_arr(p) for p in points]))
+            arr = np.zeros((width, 3, 32), dtype=np.int32)
+            if points:
+                arr[: len(points)] = np.stack(
+                    [I.g1_affine_to_jacobian_arr(p) for p in points])
+            pks = jnp.asarray(arr)
             if cache is not None:
                 cache[0] = pks
-        bm = np.asarray(bits)
+        bm = np.zeros((width,), dtype=np.int32)
+        bm[: len(points)] = np.asarray(bits, dtype=np.int32)
         TRANSFER.inc("h2d", bm.nbytes)
-        program = f"masked_sum_w{len(points)}"
-        first = _program_first_use(program)
+        program = f"masked_sum_w{width}"
+        fused = _fused()
+        fn = None
+        if fused and not kernel_twin_active():
+            fn = aot.resolve(program)
+            if fn is None:
+                fn = _get_masked_sum_fn()
+        first = _program_first_use(program) if fused else False
         t0 = time.monotonic()
-        agg = CV.masked_sum(pks, jnp.asarray(bm), CV.FP_OPS)
+        if fn is not None:
+            agg = fn(pks, jnp.asarray(bm))
+        else:
+            agg = CV.masked_sum(pks, jnp.asarray(bm), CV.FP_OPS)
         res = np.asarray(agg)
         elapsed = time.monotonic() - t0
         if first:
             JIT_COMPILE_SECONDS.set(elapsed, program=program)
         TRANSFER.inc("d2h", res.nbytes)
-        trace.annotate(program=program, width=len(points),
-                       jit_cache="miss" if first else "hit",
+        trace.annotate(program=program, width=width,
+                       jit_cache=("miss" if first else "hit")
+                       if fused else "eager",
                        h2d_bytes=bm.nbytes, d2h_bytes=res.nbytes)
         return I.arr_to_g1_affine(res)
 
@@ -533,10 +562,12 @@ BATCH_BUCKETS_CPU = (8, 64)
 BATCH_BUCKETS_TPU = (8, 64, 256)
 
 
+# graftlint: bucket-fn registry=BATCH_BUCKETS_CPU,BATCH_BUCKETS_TPU
 def batch_buckets() -> tuple:
     return BATCH_BUCKETS_TPU if device_enabled() else BATCH_BUCKETS_CPU
 
 
+# graftlint: bucket-fn registry=BATCH_BUCKETS_CPU,BATCH_BUCKETS_TPU
 def batch_bucket(n: int) -> int:
     for b in batch_buckets():
         if n <= b:
@@ -591,13 +622,18 @@ def agg_verify_batch_on_device(table: CommitteeTable, bits_list,
             sg = np.asarray(I.g2_batch_affine([chunk_s[i] for i in sel]))
             h2d += bm.nbytes + hh.nbytes + sg.nbytes
             program = f"agg_verify_batch_b{table.size}x{padded}"
+            chunk_fn = fn
+            if fused and not kernel_twin_active():
+                warm = aot.resolve(program)
+                if warm is not None:
+                    chunk_fn = warm
             first = _program_first_use(program) if fused else False
             t0 = time.monotonic()
             call_args = (tbl, asarray(bm), asarray(hh), asarray(sg))
-            ok = fn(*call_args)
+            ok = chunk_fn(*call_args)
             if first:
                 compiles.append((program, time.monotonic() - t0))
-                prof.on_first_dispatch(program, fn, call_args,
+                prof.on_first_dispatch(program, chunk_fn, call_args,
                                        time.monotonic() - t0)
             COUNTERS.inc("batch_verify")
             # a compiling chunk's drain time is compile, not execute —
@@ -673,9 +709,13 @@ def verify_on_device(pk_point, payload: bytes, sig_point) -> bool:
         sg = np.asarray(I.g2_batch_affine([sig_point] * width))
         TRANSFER.inc("h2d", pk.nbytes + hh.nbytes + sg.nbytes)
         program = f"verify_w{width}"
+        fn = _get_verify_fn() if fused else OB.verify
+        if fused and not kernel_twin_active():
+            warm = aot.resolve(program)
+            if warm is not None:
+                fn = warm
         first = _program_first_use(program) if fused else False
         t0 = time.monotonic()
-        fn = _get_verify_fn() if fused else OB.verify
         call_args = (asarray(pk), asarray(hh), asarray(sg))
         ok = fn(*call_args)
         res = np.asarray(ok)
@@ -761,13 +801,18 @@ def verify_many_on_device(pk_points, h_points, sig_points) -> list:
                 )
             h2d += pk.nbytes + hh.nbytes + sg.nbytes
             program = f"verify_w{padded}"
+            chunk_fn = fn
+            if fused and not kernel_twin_active():
+                warm = aot.resolve(program)
+                if warm is not None:
+                    chunk_fn = warm
             first = _program_first_use(program) if fused else False
             t0 = time.monotonic()
             call_args = (asarray(pk), asarray(hh), asarray(sg))
-            ok = fn(*call_args)
+            ok = chunk_fn(*call_args)
             if first:
                 compiles.append((program, time.monotonic() - t0))
-                prof.on_first_dispatch(program, fn, call_args,
+                prof.on_first_dispatch(program, chunk_fn, call_args,
                                        time.monotonic() - t0)
             pending.append((ok, n, program, None if first else t0))
         TRANSFER.inc("h2d", h2d)

@@ -279,6 +279,7 @@ class Registry:
         prof = self._prof_counters()
         if prof:
             lines.append(prof)
+        lines.append(self._aot_counters())
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -451,6 +452,16 @@ class Registry:
         from . import sched
 
         return sched.expose_metrics()
+
+    @staticmethod
+    def _aot_counters() -> str:
+        """AOT artifact/executable-cache families (aot module
+        singletons): fallback-to-jit verdicts by reason and
+        content-addressed cache traffic (hit/miss/store/corrupt/skew)
+        — the operator's answer to 'did warmup actually warm?'."""
+        from . import aot
+
+        return aot.expose()
 
     @staticmethod
     def _prof_counters() -> str:

@@ -224,6 +224,7 @@ def on_first_dispatch(program: str, fn, args: tuple,
             _programs.setdefault(program, {}).update(entry)
 
 
+# graftlint: compile-phase=diagnostic
 def _cost_analysis(fn, args: tuple) -> dict:
     """XLA's view of a jitted callable at concrete args: flops, bytes
     accessed, memory footprint.  Twin kernels (plain callables) and

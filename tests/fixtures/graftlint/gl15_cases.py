@@ -1,0 +1,82 @@
+"""GL15 fixtures: bucket derivability at compile-program sites.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+The positive cases re-create the PR-15 NEWVIEW wedge statically: a
+program name whose width placeholder cannot be derived from a pinned
+bucket registry (raw ``len()`` of runtime data, arithmetic, a helper
+that never declared itself a bucket-fn) mints a fresh XLA program at
+an unpredictable shape.  The negative cases run the SAME sink shapes
+through an annotated bucket-fn — including the guarded-placeholder
+refinement device.py's fused/eager split relies on — and stay clean
+because every derived name is covered by the committed manifest.
+"""
+
+from harmony_tpu import aot
+
+BUCKETS = (8, 16)
+
+
+# graftlint: bucket-fn registry=BUCKETS
+def bucket(n):
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(n)
+
+
+def helper_without_annotation(n):
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(n)
+
+
+def serve_pinned(items):
+    """Registry-derived width: agg_verify_b{8,16}, manifest-covered."""
+    width = bucket(len(items))
+    program = f"agg_verify_b{width}"
+    return aot.resolve(program)
+
+
+def serve_raw_len(items):
+    """The wedge itself: one program per observed committee size."""
+    program = f"agg_verify_b{len(items)}"  # expect: GL15
+    return aot.resolve(program)
+
+
+def serve_arithmetic(items):
+    width = bucket(len(items)) * 2
+    program = f"agg_verify_b{width}"  # expect: GL15
+    return aot.resolve(program)
+
+
+def serve_undeclared_helper(items):
+    """Same math as ``bucket`` but never annotated: the analysis must
+    not trust an unpinned helper's return set."""
+    program = f"agg_verify_b{helper_without_annotation(len(items))}"  # expect: GL15
+    return aot.resolve(program)
+
+
+def serve_refined(items, fused):
+    """The device.py fused/eager split: the placeholder is a guarded
+    IfExp and the sink only runs under the SAME guard, so the eager
+    branch's raw ``len`` never reaches a compile."""
+    width = bucket(len(items)) if fused else len(items)
+    program = f"agg_verify_b{width}"
+    if fused:
+        warm = aot.resolve(program)
+        if warm is not None:
+            return warm
+    return None
+
+
+def serve_conjunct_refined(items, fused, twin):
+    """Refinement through a conjunction: ``if fused and not twin``
+    still proves the bare ``fused`` test of the placeholder."""
+    width = bucket(len(items)) if fused else len(items)
+    program = f"agg_verify_b{width}"
+    if fused and not twin:
+        return aot.resolve(program)
+    return None

@@ -1,0 +1,60 @@
+"""GL16 fixtures: warmup-manifest coverage of derivable buckets.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+Every site here IS derivable (annotated bucket-fn, pinned registry) —
+the failure mode is narrower than GL15's: the derived program names
+are not all present in the committed compile manifest
+(tools/artifacts/aot/compile_manifest.json), so a warmed node would
+still pay a first-use compile the first time the bucket is hit.  The
+clean cases derive names the real manifest covers; coverage is checked
+against that committed artifact, the same diff CI gates.
+"""
+
+from harmony_tpu import aot
+
+BUCKETS = (8, 16)
+
+
+# graftlint: bucket-fn registry=BUCKETS
+def bucket(n):
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(n)
+
+
+def _program_first_use(program):
+    return False
+
+
+def serve_covered(items):
+    """agg_verify_b{8,16}: both names in the committed manifest."""
+    width = bucket(len(items))
+    program = f"agg_verify_b{width}"
+    return aot.resolve(program)
+
+
+def serve_uncovered_family(items):
+    """A family the manifest has never heard of: every derived name
+    is missing, the warmup can never precompile it."""
+    width = bucket(len(items))
+    program = f"quorum_probe_b{width}"  # expect: GL16
+    return aot.resolve(program)
+
+
+def serve_partially_covered(items):
+    """verify_w8 is in the manifest but verify_w16 is not — partial
+    coverage still leaves a first-use compile reachable."""
+    width = bucket(len(items))
+    program = f"verify_w{width}"  # expect: GL16
+    return aot.resolve(program)
+
+
+def first_use_gate(items):
+    """Same coverage contract through the first-use counter sink."""
+    program = f"replay_sweep_b{bucket(len(items))}"  # expect: GL16
+    if _program_first_use(program):
+        return None
+    return program

@@ -1,0 +1,72 @@
+"""GL17 fixtures: compile locality — every trace/lower/compile must
+live in the sanctioned device layer or a declared warmup/diagnostic
+phase.
+
+Never imported or executed; tests/test_graftlint.py lints this file and
+asserts that exactly the lines tagged ``# expect: GLxx`` are flagged.
+
+The ``compile-zone`` marker below opts this (non-harmony_tpu) file
+into the in-zone patterns — the same grammar a serving module outside
+the package tree would use.  The positive cases are the ways a compile
+has actually leaked onto a serving thread: a bare jit head, an
+immediate first-trace, a jit-bound callable traced in place, explicit
+``.lower(...)``/``.compile()`` chains.  The negative cases carry the
+phase annotations (warmup / diagnostic) that sanction a compile off
+the serving path, including through a nested def.
+"""
+
+# graftlint: compile-zone=serving
+
+import jax
+
+
+def bare_head(xs):
+    fn = jax.jit(lambda a: a)  # expect: GL17
+    return fn(xs)  # expect: GL17
+
+
+def immediate_first_trace(xs):
+    return jax.jit(lambda a: a + 1)(xs)  # expect: GL17
+
+
+@jax.jit  # expect: GL17
+def decorated(x):
+    return x
+
+
+def explicit_lower(fn, xs):
+    lowered = fn.lower(xs)  # expect: GL17
+    return lowered.compile()  # expect: GL17
+
+
+def lower_compile_chain(fn):
+    return fn.lower().compile()  # expect: GL17
+
+
+# graftlint: compile-phase=warmup
+def warmup_precompile(fn, spec):
+    """Startup warmup: compiles are the POINT here — exempt."""
+    lowered = fn.lower(spec)
+    compiled = lowered.compile()
+    jitted = jax.jit(lambda a: a)
+    jitted(spec)
+    return compiled
+
+
+# graftlint: compile-phase=warmup
+def warmup_with_nested(fn, specs):
+    """The phase annotation reaches nested defs: closures spawned by
+    a warmup routine are still warmup."""
+
+    def one(spec):
+        return fn.lower(spec).compile()
+
+    return [one(s) for s in specs]
+
+
+# graftlint: compile-phase=diagnostic
+def cost_probe(fn, args):
+    """prof.py's cost-analysis shape: a diagnostic compile, off the
+    serving path by construction."""
+    compiled = fn.lower(*args).compile()
+    return compiled.cost_analysis()

@@ -53,6 +53,7 @@ def _expected(path: Path) -> set:
     "gl05_cases.py", "gl06_cases.py", "gl07_cases.py", "gl08_cases.py",
     "gl09_cases.py", "gl10_cases.py", "gl11_cases.py",
     "gl12_cases.py", "gl13_cases.py", "gl14_cases.py",
+    "gl15_cases.py", "gl16_cases.py", "gl17_cases.py",
 ])
 def test_fixture_exact_lines(name):
     """Each rule family flags exactly the tagged lines — no more, no

@@ -2,15 +2,21 @@
 # Pre-commit gate for harmony-tpu.
 #
 # Three stages, fail-fast:
-#   1. graftlint — whole-program static analysis (GL01-GL14: the
+#   1. graftlint — whole-program static analysis (GL01-GL17: the
 #      classic families, the kernelcheck pass — GL09 limb
 #      value-range abstract interpretation, GL10 Montgomery-domain
-#      typestate, GL11 twin/padding discipline — and the thread-role
+#      typestate, GL11 twin/padding discipline — the thread-role
 #      & trust-boundary pass — GL12 dispatch discipline over the
 #      role-annotated call graph, GL13 wire-taint budgets on every
 #      trust-boundary decoder, GL14 watchdog heartbeat coverage for
-#      spawned long-lived loops) against the committed baseline,
-#      gated at 0 new findings.  Exit-code contract (stable for hooks): 0 clean,
+#      spawned long-lived loops — and the compile-surface pass —
+#      GL15 bucket derivability for every serving-path XLA program,
+#      GL16 warmup-manifest coverage, GL17 compile locality) against
+#      the committed baseline, gated at 0 new findings.  The stage
+#      then re-derives the compile manifest and diffs it against the
+#      committed tools/artifacts/aot/compile_manifest.json — drift
+#      fails LOUDLY: a changed compile surface must ship its manifest.
+#      Exit-code contract (stable for hooks): 0 clean,
 #      1 new violations, 2 internal linter error — any non-zero stops
 #      this script with the same code.  This stage warms the
 #      content-hash result cache (.graftlint_cache.json), so the
@@ -18,7 +24,12 @@
 #      instead of re-analyzing an unchanged tree.
 #   2. tier-1 smoke subset — the fast, pure-CPU slices that catch the
 #      classes of regression this repo's PRs most often introduce
-#      (linter self-tests, device-path wiring, thread-safety, codecs).
+#      (linter self-tests, device-path wiring, AOT executable cache,
+#      thread-safety, codecs) — then tools/compile_surface_smoke.py,
+#      the load-bearing end of the GL16 contract: warm every manifest
+#      program, drive a localnet-shaped node across a committee-width
+#      change (5 -> 12 keys, bucket 8 -> 16), and assert ZERO
+#      post-warmup compiles (device JIT miss counter frozen).
 #   3. chaos smoke — the fault-injection tier (resilience primitives +
 #      flapping-backend/black-holed-peer scenarios).  Deterministic by
 #      construction: faults are counted, jitter is hashed, breaker
@@ -103,17 +114,34 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== graftlint: whole-program gate vs committed baseline (GL01-GL14) =="
+echo "== graftlint: whole-program gate vs committed baseline (GL01-GL17) =="
 python -m tools.graftlint
+
+echo "== compile manifest: committed copy vs derived surface =="
+MANIFEST_TMP="$(mktemp)"
+python -m tools.graftlint --emit-compile-manifest > "$MANIFEST_TMP"
+if ! diff -u tools/artifacts/aot/compile_manifest.json "$MANIFEST_TMP"; then
+  rm -f "$MANIFEST_TMP"
+  echo "STALE COMPILE MANIFEST: the serving-path compile surface changed" >&2
+  echo "but tools/artifacts/aot/compile_manifest.json was not regenerated." >&2
+  echo "Run: python -m tools.graftlint --emit-compile-manifest \\" >&2
+  echo "       > tools/artifacts/aot/compile_manifest.json  and commit it." >&2
+  exit 1
+fi
+rm -f "$MANIFEST_TMP"
 
 echo "== tier-1 smoke subset =="
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_graftlint.py \
   tests/test_device_path.py \
+  tests/test_aot_cache.py \
   tests/test_concurrency.py \
   tests/test_rlp_trie.py \
   tests/test_config.py
+
+echo "== compile surface smoke: zero post-warmup compiles across a width change =="
+JAX_PLATFORMS=cpu python tools/compile_surface_smoke.py
 
 echo "== chaos smoke: fault-injection tier =="
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \

@@ -1,0 +1,133 @@
+"""Compile-surface smoke: ZERO first-use compiles after warmup across
+a committee-width change — the exact PR-15 trigger.
+
+The check.sh stage for ISSUE 17's acceptance: the first NEWVIEW at a
+new committee width used to mint a fresh XLA program on the consensus
+pump thread and wedge every validator ~90s.  This smoke proves the
+warmup manifest actually covers the serving surface:
+
+  1. ``aot.startup_warmup()`` warms every program in the committed
+     compile manifest (GL16's machine-checked shape set);
+  2. every device entry family (agg_verify, batched replay, single
+     verify, continuous-batch verify_many) is driven at committee
+     width 5 (bucket 8) and AGAIN at width 12 (bucket 16 — the width
+     change that wedged PR 15);
+  3. the device JIT first-use counter must not move: every program
+     the drive dispatched was already warm, and every program it
+     touched is in the manifest.
+
+Runs under the kernel twins (the same layer split every other CI
+localnet stage uses): first-use accounting is identical on the twin
+path — ``_program_first_use`` fires per program name regardless of
+backend — so a manifest gap shows up as a JIT miss here in seconds
+instead of a 90s pump wedge on a TPU.
+
+Usage: python tools/compile_surface_smoke.py   (exit 0 = gate passed)
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["HARMONY_KERNEL_TWIN"] = "1"  # twin kernels: real device-
+# path layers (tables, bitmaps, counters) without XLA pairing compiles
+
+
+def fail(msg: str) -> None:
+    print(f"compile_surface_smoke FAIL: {msg}", flush=True)
+    sys.exit(1)
+
+
+def drive_width(n_keys: int) -> list:
+    """Every serving-path device entry family at one committee width;
+    returns the program names dispatched (from the seen-set)."""
+    from harmony_tpu import bls as B
+    from harmony_tpu import device as DV
+    from harmony_tpu.ref.hash_to_curve import hash_to_g2
+
+    payload = b"compile-surface-smoke-payload-32"
+    keys = [B.PrivateKey.generate(bytes([30 + n_keys + i]))
+            for i in range(n_keys)]
+    sigs = [k.sign_hash(payload) for k in keys]
+    agg = B.aggregate_sigs(sigs)
+    h = hash_to_g2(payload)
+    table = DV.CommitteeTable([k.pub.point for k in keys])
+
+    # fused quorum check (consensus pump shape), accept AND reject
+    ok = DV.agg_verify_hashed_on_device(
+        table, [1] * n_keys, h, agg.point)
+    if not ok:
+        fail(f"agg_verify accept failed at width {n_keys}")
+    if DV.agg_verify_hashed_on_device(
+            table, [1] * (n_keys - 1) + [0], h, agg.point):
+        fail(f"agg_verify reject failed at width {n_keys}")
+
+    # batched replay (sync/catch-up shape)
+    batch = DV.agg_verify_batch_on_device(
+        table, [[1] * n_keys] * 3, [h] * 3, [agg.point] * 3)
+    if batch != [True, True, True]:
+        fail(f"agg_verify_batch failed at width {n_keys}: {batch}")
+
+    # single check (view-change vote shape)
+    if not DV.verify_on_device(keys[0].pub.point, payload,
+                               sigs[0].point):
+        fail(f"verify_single failed at width {n_keys}")
+
+    # continuous-batch independent checks (scheduler coalesce shape)
+    many = DV.verify_many_on_device(
+        [k.pub.point for k in keys], [h] * n_keys,
+        [s.point for s in sigs])
+    if many != [True] * n_keys:
+        fail(f"verify_many failed at width {n_keys}: {many}")
+
+
+def main() -> int:
+    from harmony_tpu import aot
+    from harmony_tpu import device as DV
+
+    DV.use_device(True)
+    manifest = aot.load_manifest()
+    if manifest is None:
+        fail(f"no compile manifest at {aot.MANIFEST_PATH} — "
+             "regenerate with python -m tools.graftlint "
+             "--emit-compile-manifest")
+    covered = set(aot.manifest_names(manifest)) | {"verify_w1"}
+
+    stats = aot.startup_warmup()
+    if not stats or stats["mode"] != "twin":
+        fail(f"warmup did not run in twin mode: {stats}")
+    if stats["warmed"] < len(covered):
+        fail(f"warmup marked {stats['warmed']} programs, manifest has "
+             f"{len(covered)}")
+
+    miss0, hit0 = DV.JIT["miss"], DV.JIT["hit"]
+    drive_width(5)    # committee bucket 8
+    drive_width(12)   # committee bucket 16 — the PR-15 width change
+    misses = DV.JIT["miss"] - miss0
+    hits = DV.JIT["hit"] - hit0
+
+    if misses:
+        cold = sorted(DV._SEEN_PROGRAMS - covered)
+        fail(f"{misses} post-warmup first-use compile(s); programs "
+             f"outside the manifest: {cold}")
+    if hits <= 0:
+        fail("drive dispatched no warm programs — smoke drove nothing")
+    uncovered = sorted(DV._SEEN_PROGRAMS - covered)
+    if uncovered:
+        fail(f"programs dispatched outside the manifest: {uncovered}")
+
+    print(
+        "compile_surface_smoke OK: committee width 5 -> 12 (bucket "
+        f"8 -> 16), {hits} warm dispatches, 0 post-warmup compiles "
+        f"({stats['warmed']} programs warmed, mode={stats['mode']})",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

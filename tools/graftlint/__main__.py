@@ -99,6 +99,12 @@ def _parse_args(argv):
     ap.add_argument("--dot", action="store_true",
                     help="emit the whole-program call graph as DOT on "
                          "stdout and exit 0")
+    ap.add_argument("--emit-compile-manifest", action="store_true",
+                    help="emit the canonical warmup manifest "
+                         "(compilesurface's derived program set) as "
+                         "JSON on stdout and exit 0; CI diffs this "
+                         "against tools/artifacts/aot/"
+                         "compile_manifest.json")
     ap.add_argument("--no-cache", action="store_true",
                     help="bypass the content-hash result cache "
                          "(.graftlint_cache.json) and re-analyze")
@@ -167,7 +173,8 @@ def main(argv=None) -> int:
         lint_scope = changed
 
     t0 = time.monotonic()
-    program_out: list = [] if args.dot else None
+    program_out: list = [] if (args.dot or args.emit_compile_manifest) \
+        else None
     result = lint_paths(lint_scope, only, program_out=program_out,
                         use_cache=not args.no_cache)
 
@@ -180,6 +187,14 @@ def main(argv=None) -> int:
         from .interproc import to_dot
 
         sys.stdout.write(to_dot(program_out[0]))
+        return 0
+
+    if args.emit_compile_manifest:
+        import json as _json
+
+        from . import compilesurface as CS
+
+        print(_json.dumps(CS.emit_manifest(program_out[0]), indent=1))
         return 0
 
     if args.write_baseline:

@@ -52,10 +52,21 @@ RULES = {
     "GL14": "watchdog coverage: every spawned long-lived loop "
             "declares a thread-role, registers a health.Heartbeat "
             "and beats it",
+    "GL15": "bucket derivability: every serving-path compile program's "
+            "shape placeholders derive from a pinned bucket registry "
+            "through verified bucket-fns (the static NEWVIEW-wedge "
+            "class)",
+    "GL16": "manifest coverage: every derivable compile program is in "
+            "the committed warmup manifest, and every committed name "
+            "is still derivable (--emit-compile-manifest regenerates)",
+    "GL17": "compile locality: no lower()/compile()/first-trace or "
+            "bare compile head outside the device layer or an "
+            "annotated warmup/diagnostic phase",
 }
 INTERPROC_RULES = {"GL05", "GL06", "GL07", "GL08"}
 KERNEL_RULES = {"GL09", "GL10", "GL11"}
 THREADROLE_RULES = {"GL12", "GL13", "GL14"}
+COMPILESURFACE_RULES = {"GL15", "GL16", "GL17"}
 
 # -- rule scoping over harmony_tpu/ -----------------------------------------
 
@@ -119,6 +130,10 @@ def _rule_applies(rule: str, relpath: str) -> bool:
         return relpath in _GL13_FILES
     if rule in THREADROLE_RULES:
         # GL12/GL14 self-limit to annotated spawn sites and role cones
+        return True
+    if rule in COMPILESURFACE_RULES:
+        # compilesurface self-limits to program sites, bucket-fn
+        # annotations and the sanctioned-device-layer boundary
         return True
     return False
 
@@ -217,7 +232,8 @@ def _interproc_findings(sources: dict, supps: dict,
     """Whole-program pass over {relpath: (source, tree)}."""
     from . import interproc as IP
 
-    whole = INTERPROC_RULES | KERNEL_RULES | THREADROLE_RULES
+    whole = (INTERPROC_RULES | KERNEL_RULES | THREADROLE_RULES
+             | COMPILESURFACE_RULES)
     wanted = whole if only_rules is None else whole & only_rules
     if not wanted and program_out is None:
         return []
@@ -242,6 +258,11 @@ def _interproc_findings(sources: dict, supps: dict,
         from . import threadrole as TR
 
         raw += [f for f in TR.threadrole_findings(prog)
+                if f.rule in wanted]
+    if wanted & COMPILESURFACE_RULES:
+        from . import compilesurface as CS
+
+        raw += [f for f in CS.compilesurface_findings(prog)
                 if f.rule in wanted]
     findings = []
     for sf in raw:
@@ -305,7 +326,9 @@ def _aux_inputs_sha(texts: dict) -> list[tuple[str, str]]:
     The committed baseline rides along for the same reason: a pin edit
     must never answer from a verdict cached against the old pins
     (inline ``# graftlint: disable=`` pins are already covered — they
-    live in the linted files and therefore in the file shas)."""
+    live in the linted files and therefore in the file shas).  The
+    committed compile manifest is GL16's comparison target, so it keys
+    the cache the same way."""
     from . import cache as CA
 
     out = []
@@ -317,6 +340,15 @@ def _aux_inputs_sha(texts: dict) -> list[tuple[str, str]]:
         ))
     except OSError:
         pass  # no baseline yet: its absence is keyed by the empty list
+    from . import compilesurface as CS
+
+    try:
+        out.append((
+            "aux:" + CS.MANIFEST_PATH.as_posix(),
+            CA.file_sha(CS.MANIFEST_PATH.read_text(encoding="utf-8")),
+        ))
+    except OSError:
+        pass  # no manifest yet: GL16 reports the gap, the key is empty
 
     roots = {REPO_ROOT / "tests"}
     for src in texts.values():
