@@ -65,6 +65,18 @@ def external_bls_key(seed: int, index: int = 0):
     )
 
 
+def observer_bls_key(seed: int, index: int = 0):
+    """The i-th late-join OBSERVER key of a scenario seed (ISSUE 18):
+    deterministic, never seated in any committee — the joining node
+    validates and follows the chain but cannot vote, so its mid-run
+    arrival never perturbs quorum arithmetic."""
+    from .. import bls as B
+
+    return B.PrivateKey.generate(
+        b"chaos-observer-bls-%d-%d" % (seed, index)
+    )
+
+
 def external_validator_stake(staker_key, ext_bls, *, nonce: int = 0,
                              chain_id: int = 2):
     """A signed CREATE_VALIDATOR registering ``ext_bls`` with its BLS

@@ -81,6 +81,9 @@ class NodeHandle:
     restarts: int = 0
     byz: bool = False          # an ACTIVE adversary (ByzantineNode):
     #                            excluded from liveness/fork invariants
+    dark: bool = False         # a late_join member not yet joined:
+    #                            node is None until a Phase joins it
+    joined_at: float = None    # monotonic time the member came online
 
 
 @dataclass
@@ -102,9 +105,14 @@ class RunEnv:
         return [h for h in self.handles if h.shard == shard]
 
     def honest(self, shard: int) -> list:
-        """The shard's honest nodes — what the liveness / fork
-        invariants judge.  An adversary's own chain is its problem."""
-        return [h for h in self.by_shard(shard) if not h.byz]
+        """The shard's honest LIVE nodes — what the liveness / fork
+        invariants judge.  An adversary's own chain is its problem; a
+        dark late_join member has no node yet (once joined it is held
+        to the same invariants as everyone else)."""
+        return [
+            h for h in self.by_shard(shard)
+            if not h.byz and h.node is not None
+        ]
 
     def shard_head(self, shard: int) -> int:
         """Network head: max over the shard's HONEST nodes (a
@@ -160,8 +168,14 @@ def _build(scenario: Scenario, registry, built: list | None = None
     n_accounts = n_keys
     if scenario.traffic.node_pool_rate > 0:
         n_accounts = max(n_keys, 64)
+    if top.n_accounts:
+        # mainnet-shaped allocation (ISSUE 18): the rehearsal's state
+        # is large on purpose — genesis build, per-block serialization
+        # and the snapshot bootstrap all pay for it
+        n_accounts = max(n_accounts, top.n_accounts)
     genesis0, ecdsa_keys, bls_keys = dev_genesis(
-        n_accounts=n_accounts, n_keys=n_keys, shard_id=0
+        n_accounts=n_accounts, n_keys=n_keys, shard_id=0,
+        flat_root=top.flat_root,
     )
     shard_genesis = {0: genesis0}
     for s in range(1, top.shards):
@@ -305,6 +319,7 @@ def _build(scenario: Scenario, registry, built: list | None = None
             handle._registry.set("downloader", Downloader(
                 handle.chain, handle.sync_clients, verify_seals=True,
                 request_deadline_s=2.0,
+                snapshot_threshold=top.snapshot_threshold,
             ))
 
     env.data["wire_node"] = wire_node
@@ -316,7 +331,7 @@ def _build(scenario: Scenario, registry, built: list | None = None
         env.data["data_dir"] = tempfile.mkdtemp(prefix="harmony-chaos-")
 
     for s in range(top.shards):
-        for i in range(top.nodes):
+        for i in range(top.nodes + top.late_join):
             # the handle registers BEFORE its resources are allocated:
             # if any later step raises (port bind on a loaded box, a
             # wedged sidecar dial), run()'s teardown still closes
@@ -327,6 +342,16 @@ def _build(scenario: Scenario, registry, built: list | None = None
                 handle.data_path = os.path.join(
                     env.data["data_dir"], f"{handle.name}.kv"
                 )
+            if i >= top.nodes:
+                # a late_join member starts DARK: keys assigned (a
+                # non-committee observer key), everything else waits
+                # for its Phase.joins trigger — until then the member
+                # has no host, server, downloader, node or pump
+                handle.dark = True
+                handle.keys = [
+                    FX.observer_bls_key(scenario.seed, i - top.nodes)
+                ]
+                continue
             key_index = sum(spans[:i])
             keys = list(bls_keys[key_index:key_index + spans[i]])
             if s == 0 and i < len(ext_keys):
@@ -339,8 +364,10 @@ def _build(scenario: Scenario, registry, built: list | None = None
 
     # sync mesh per shard: every node can pull from every other —
     # consensus-timeout sync and post-heal rejoin both need a peer
+    # (dark members wire at join time)
     for h in env.handles:
-        wire_sync(h)
+        if not h.dark:
+            wire_sync(h)
 
     # resource baseline for the overload invariants: what the process
     # held BEFORE any traffic — the bounded-resources check diffs the
@@ -357,6 +384,8 @@ def _build(scenario: Scenario, registry, built: list | None = None
             ecdsa_keys[i], ext, chain_id=CHAIN_ID
         )
         for h in env.by_shard(0):
+            if h.pool is None:
+                continue  # a dark late_join member has no pool yet
             try:
                 h.pool.add(stx, is_staking=True)
             except Exception as e:  # noqa: BLE001 — a rejected stake
@@ -412,7 +441,7 @@ def _node_pool_flood(env: RunEnv, txs, rate: float, duration_s: float,
 
     try:
         ready.wait()
-        pools = [h.pool for h in env.by_shard(0)]
+        pools = [h.pool for h in env.by_shard(0) if h.pool is not None]
         start = time.monotonic()
         n = 0
         for i in FX.paced_ticks(rate, stop, duration_s):
@@ -510,6 +539,8 @@ def _cx_submitter(env: RunEnv, stop):
                 to_shard=1, to=dest, value=value,
             ).sign(sender_key, CHAIN_ID)
             for h in env.by_shard(0):
+                if h.pool is None:
+                    continue  # dark late_join member
                 try:
                     h.pool.add(tx, sender=sender)
                 except Exception:  # noqa: BLE001 — pool dedup/caps
@@ -617,6 +648,31 @@ def _restart_node(env: RunEnv, handle) -> None:
         rolled_back=h.chain.recovered_blocks,
         restarts=h.restarts,
     )
+
+
+def _join_node(env: RunEnv, handle) -> None:
+    """Bring a dark ``late_join`` member online mid-run (ISSUE 18):
+    first wiring of its node (gossip host joins the hub, sync server
+    binds a fresh port) and its downloader — built with the topology's
+    ``snapshot_threshold``, so a joiner far enough behind bootstraps
+    from a peer-served snapshot before tail replay.  Peers are NOT
+    rewired: the joiner PULLS through its own clients (serving the
+    joiner is not load-bearing for the bootstrap; a peer's lazy client
+    picks the fresh port up only through its own restart path)."""
+    h = handle
+    h.dark = False
+    env.data["wire_node"](h)
+    env.data["wire_sync"](h)
+    h.joined_at = time.monotonic()
+    behind = env.shard_head(h.shard) - h.chain.head_number
+    env.data["join_lag"] = max(env.data.get("join_lag", 0), behind)
+    top = env.scenario.topology
+    h.pump = h.node.run_forever(
+        poll_interval=0.002,
+        block_time=top.block_time_s,
+        phase_timeout=top.phase_timeout_s,
+    )
+    _log.warn("chaos node joined", node=h.name, behind=behind)
 
 
 # -- the fault-script timeline -----------------------------------------------
@@ -743,6 +799,9 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
     # heal watches (measure_heal): {"h", "at"} — healed-isolate
     # catch-up timers, resolved when the node reaches the shard head
     heal_watch: list = []
+    # join watches: late_join members brought online, resolved when
+    # the joiner reaches the shard head (join-to-caught-up seconds)
+    join_watch: list = []
     by_name = {h.name: h for h in env.handles}
 
     def kill_open(t):
@@ -751,7 +810,7 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
     try:
         while not stop.is_set():
             finite = bool(
-                pending or heal_watch
+                pending or heal_watch or join_watch
                 or any(kill_open(t) for t in kills)
                 or any(end is not None for _, end, _, _ in active)
             )
@@ -803,6 +862,20 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                     if phase.duration_s is not None:
                         kw.setdefault("t1", phase.duration_s)
                     FI.arm(**kw)
+                for nm in phase.joins:
+                    h = by_name.get(nm)
+                    if h is None or not h.dark:
+                        env.errors.append(
+                            f"phase {phase.name}: join target {nm} is "
+                            "not a dark late_join member"
+                        )
+                        continue
+                    try:
+                        _join_node(env, h)
+                        join_watch.append({"h": h, "at": time.monotonic()})
+                    except Exception as e:  # noqa: BLE001 — a member
+                        # that cannot come online IS the finding
+                        env.errors.append(f"join {nm}: {e!r}")
                 for kill in phase.kills:
                     for nm in _resolve_partition(env, kill.target):
                         h = by_name.get(nm)
@@ -906,6 +979,20 @@ def _timeline(env: RunEnv, stop, t0: float, phases_done):
                         head=h.chain.head_number,
                         heal_catchup_s=round(catchup, 2),
                     )
+            for w in join_watch[:]:
+                # late joiner has caught up to the live network head
+                h = w["h"]
+                if h.chain.head_number >= env.shard_head(h.shard):
+                    catchup = time.monotonic() - w["at"]
+                    env.data.setdefault(
+                        "join_catchup_s", []
+                    ).append(catchup)
+                    join_watch.remove(w)
+                    _log.warn(
+                        "chaos joined node caught up", node=h.name,
+                        head=h.chain.head_number,
+                        join_catchup_s=round(catchup, 2),
+                    )
             time.sleep(0.05)
     finally:
         # scenario end or abort: heal every link rule we installed
@@ -1003,7 +1090,10 @@ def _check_invariants(env: RunEnv, sheds: float) -> list:
                     f"{len(hashes)} distinct blocks among honest nodes",
                 )
     if inv.min_view_changes:
-        vcs = sum(h.node.new_views_adopted for h in env.handles)
+        vcs = sum(
+            h.node.new_views_adopted
+            for h in env.handles if h.node is not None
+        )
         if vcs < inv.min_view_changes:
             violated(
                 "view_change_completed",
@@ -1106,7 +1196,8 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
                 pressured_ingress_rate=50.0,
             )
             for h in env.by_shard(0):
-                gov.attach_pool(h.pool)
+                if h.pool is not None:  # dark members have no pool yet
+                    gov.attach_pool(h.pool)
             GV.install(gov)
             gov.start()
             env.data["governor"] = gov
@@ -1170,12 +1261,14 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         for t in threads:
             t.start()
         for h in env.handles:
+            if h.dark:
+                continue  # late_join members pump at join time
             h.pump = h.node.run_forever(
                 poll_interval=0.002,
                 block_time=scenario.topology.block_time_s,
                 phase_timeout=scenario.topology.phase_timeout_s,
             )
-        pumps = [h.pump for h in env.handles]
+        pumps = [h.pump for h in env.handles if h.pump is not None]
         ready.set()
 
         deadline = t0 + scenario.window_s
@@ -1202,7 +1295,8 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
             heads_ok = all(
                 h.node.chain.head_number
                 >= scenario.invariants.min_blocks
-                for h in env.handles if not h.byz
+                for h in env.handles
+                if not h.byz and h.node is not None
             )
             tick += 1
             if (heads_ok and phases_done.is_set()
@@ -1317,7 +1411,10 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
 
     p50, p99 = _quantiles(list(env.round_durs.values()))
     heads = {
-        s: [h.node.chain.head_number for h in env.by_shard(s)]
+        s: [
+            h.node.chain.head_number
+            for h in env.by_shard(s) if h.node is not None
+        ]
         for s in range(scenario.topology.shards)
     }
     faults_fired = sum(
@@ -1342,10 +1439,12 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         ),
         "consensus_sheds": _m(sheds, "sheds"),
         "view_changes": _m(
-            sum(h.node.view_changes for h in env.handles), "votes",
+            sum(h.node.view_changes for h in env.handles
+                if h.node is not None), "votes",
         ),
         "new_views_adopted": _m(
-            sum(h.node.new_views_adopted for h in env.handles),
+            sum(h.node.new_views_adopted for h in env.handles
+                if h.node is not None),
             "adoptions",
         ),
         "fault_point_hits": _m(faults_fired, "hits"),
@@ -1367,6 +1466,34 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         )
         metrics["heal_lag_blocks"] = _m(
             env.data.get("heal_lag", 0), "blocks",
+        )
+    # late-join bootstrap telemetry (ISSUE 18): any downloader that
+    # installed a peer-served snapshot reports it here — the joiner's
+    # meta-to-install seconds are the BENCH ledger's
+    # snapshot_bootstrap_seconds yardstick
+    boot_dls = []
+    for h in env.handles:
+        reg = getattr(h, "_registry", None)
+        dl = reg.get("downloader") if reg is not None else None
+        if dl is not None and getattr(dl, "snapshot_bootstraps", 0):
+            boot_dls.append(dl)
+    if boot_dls:
+        metrics["snapshot_bootstraps"] = _m(
+            sum(d.snapshot_bootstraps for d in boot_dls), "bootstraps",
+        )
+        metrics["snapshot_bootstrap_seconds"] = _m(
+            round(max(d.last_snapshot_bootstrap_s for d in boot_dls), 3),
+            "s", derived_from="meta_to_install",
+            block=max(d.last_snapshot_block or 0 for d in boot_dls),
+        )
+    joins = env.data.get("join_catchup_s")
+    if joins:
+        metrics["join_catchup_seconds"] = _m(
+            round(max(joins), 3), "s", joins=len(joins),
+            derived_from="join_to_caught_up",
+        )
+        metrics["join_lag_blocks"] = _m(
+            env.data.get("join_lag", 0), "blocks",
         )
     # scenario-specific measured extras (the byzantine scenarios stash
     # their evidence-pipeline numbers here from custom invariants)

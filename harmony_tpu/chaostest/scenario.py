@@ -70,6 +70,28 @@ class Topology:
     # tighten its check interval): the wedged-thread scenario needs
     # detection inside its fault window
     watchdog_max_age_s: float | None = None
+    # late-join bootstrap (ISSUE 18): this many EXTRA nodes — named
+    # ``s<shard>n<nodes+i>`` — are built DARK: a handle with keys and a
+    # data dir but no gossip host, sync server, downloader or pump
+    # until a Phase ``joins`` them mid-run.  A dark member holds a
+    # NON-committee BLS key (deterministic from the seed), so it runs
+    # as an observer once joined: it validates and follows the chain
+    # but never votes — quorum arithmetic is untouched by its absence
+    late_join: int = 0
+    # snapshot-or-replay decision threshold wired into every node's
+    # downloader: a node >= this many blocks behind the network head
+    # bootstraps from a peer-served snapshot (verified against the
+    # sealed state root) before tail replay.  None = always replay —
+    # the default keeps every pre-existing scenario byte-identical
+    snapshot_threshold: int | None = None
+    # dev-genesis account scale: 0 derives the minimum (one funded
+    # account per committee key, widened to 64 under an overload
+    # flood); the dress rehearsal sets a mainnet-shaped allocation
+    n_accounts: int = 0
+    # gate the MPT root off (headers commit the flat sha3 root): the
+    # only viable shape for a large-state scenario, where a
+    # pure-python secure-trie seal would take minutes per block
+    flat_root: bool = False
 
 
 @dataclass(frozen=True)
@@ -147,7 +169,16 @@ class Phase:
     ``measure_heal`` records, for each node the phase fully isolated,
     its blocks-behind lag at heal time (``env.data["heal_lag"]``) and
     the heal-to-caught-up seconds (``env.data["heal_catchup_s"]``,
-    surfaced as the ``heal_catchup_seconds`` scenario metric)."""
+    surfaced as the ``heal_catchup_seconds`` scenario metric).
+
+    ``joins`` (ISSUE 18) names dark ``Topology(late_join=...)`` members
+    to bring online at trigger time: first wiring of the node (gossip
+    host joins the hub, sync server binds, downloader built with the
+    topology's ``snapshot_threshold``), pump started, and a join watch
+    armed — the runner records the joiner's blocks-behind lag at join
+    (``env.data["join_lag"]``) and its join-to-caught-up seconds
+    (``env.data["join_catchup_s"]``, surfaced as the
+    ``join_catchup_seconds`` scenario metric)."""
 
     name: str
     at_round: int | None = None
@@ -159,6 +190,7 @@ class Phase:
     cut_sync: bool = False
     measure_heal: bool = False
     kills: tuple = ()  # Kill specs executed at trigger time
+    joins: tuple = ()  # dark late_join member names brought online
     hold_until: object = None    # fn(env) -> bool, checked after duration_s
     hold_max_s: float = 30.0     # hard cap on a held window, from trigger
 

@@ -439,10 +439,10 @@ def _byz_equivocation_survived(env):
         return False, "the adversary never withheld a vote"
     if sum(h.node.new_views_adopted for h in env.honest(0)) < 1:
         return False, "the wedged round never view-changed"
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "byz_equivocations": _m(acts["equivocate"], "announces"),
         "byz_votes_withheld": _m(acts["withhold"], "votes"),
-    }
+    })
     return True, ""
 
 
@@ -497,7 +497,7 @@ def _byz_evidence_applied(env):
         return False, (
             f"slashed key still elected at epoch {top_epoch}"
         )
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "byz_double_votes": _m(
             byz[0].node.byz_actions["double_vote"], "votes"
         ),
@@ -505,7 +505,7 @@ def _byz_evidence_applied(env):
         "byz_evidence_included_block": _m(included_at, "block"),
         "byz_offender_stake_slashed_atto": _m(slashed, "atto"),
         "byz_evidence_applied": _m(1, "records"),
-    }
+    })
     return True, ""
 
 
@@ -533,13 +533,13 @@ def _byz_spray_defended(env):
         # the muted adversary's garbage (or silent) round must have
         # been routed around by a completed view change at least once
         return False, "no honest view change routed around the sprayer"
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "byz_invalid_proposals": _m(acts["invalid_proposal"],
                                     "announces"),
         "byz_wires_sprayed": _m(acts["wire_spray"], "frames"),
         "byz_invalid_verdicts": _m(env.net.invalid_total, "rejects"),
         "byz_peers_muted": _m(len(env.net.muted), "peers"),
-    }
+    })
     return True, ""
 
 
@@ -689,10 +689,10 @@ def _no_wedge(env):
             f"only {tot.get('delayed', 0)} messages conditioned — the "
             "gray links never engaged"
         )
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "gray_window_blocks": _m(committed, "blocks"),
         "gray_window_adoptions": _m(adoptions, "adoptions"),
-    }
+    })
     return True, ""
 
 
@@ -760,10 +760,10 @@ def _asymmetric_defended(env):
             "no NEWVIEW assembled without the deaf leader's "
             "cooperation"
         )
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "asym_inbound_dropped": _m(tot["dropped"], "messages"),
         "asym_adoptions": _m(adoptions, "adoptions"),
-    }
+    })
     return True, ""
 
 
@@ -920,10 +920,10 @@ def _wan_committee_live(env):
             f"only {tot.get('delayed', 0)} messages rode the WAN "
             "matrix — the conditioner never engaged"
         )
-    env.data["extra_metrics"] = {
+    env.data.setdefault("extra_metrics", {}).update({
         "wan_committee_slots": _m(slots, "slots"),
         "wan_delayed_messages": _m(tot["delayed"], "messages"),
-    }
+    })
     return True, ""
 
 
@@ -1205,6 +1205,131 @@ def wedged_thread_recovery(quick: bool = False) -> Scenario:
     )
 
 
+# -- the dress rehearsal (ISSUE 18): everything at once ----------------------
+
+
+def _late_join_bootstrapped(env):
+    """The gating late-join arc, end to end: the dark member actually
+    came online mid-run, detected it was behind through the normal
+    gossip path (sync spin-up), installed a PEER-SERVED snapshot
+    (paged over the sync mesh, header hash agreed by peers, accounts
+    bound to the sealed state root before adoption), and caught up to
+    the live head — the runner surfaces the measured
+    ``snapshot_bootstrap_seconds`` / ``join_catchup_seconds``.  One
+    history is the standard no_divergent_heads invariant's job (the
+    joined observer is judged like every other honest node)."""
+    members = [
+        h for h in env.handles if h.dark or h.joined_at is not None
+    ]
+    if not members:
+        return False, "the topology seats no late_join member"
+    h = members[0]
+    if h.node is None:
+        return False, "the late joiner never joined"
+    if h.node.sync_spinups < 1:
+        return False, (
+            "the joiner never spun up its downloader — it did not "
+            "detect it was behind"
+        )
+    dl = h._registry.get("downloader")
+    if dl is None:
+        return False, "the joiner has no downloader"
+    if dl.snapshot_bootstraps < 1:
+        return False, (
+            "the joiner never installed a served snapshot (it caught "
+            "up by replay alone — the bootstrap path was not exercised)"
+        )
+    if not env.data.get("join_catchup_s"):
+        return False, "the joiner never caught up to the live head"
+    return True, ""
+
+
+def mainnet_rehearsal(quick: bool = False) -> Scenario:
+    """The gating dress rehearsal (ISSUE 18): one long-horizon run
+    composing every fault axis this framework owns, at a
+    mainnet-shaped state scale.  The WHOLE run rides the WAN netem
+    matrix (50–150 ms seed-keyed RTTs, jitter, loss); a staked
+    external validator riding the byzantine node double-votes once
+    elected and the full slashing pipeline must land (detect ->
+    include -> apply); a 10x overload flood drives the governor
+    through its tiers; a single-slot validator is hard-killed
+    MID-COMMIT (storage batch torn) and restarts from disk mid-epoch;
+    EPoS elections rotate the committee every 4 blocks throughout;
+    and a dark late-join member comes online mid-run and must
+    bootstrap from a peer-served snapshot of the 10^4-account state
+    before tail replay.  The genesis allocation is 10^4 accounts with
+    the flat sha3 root sealed in every header (the only viable
+    large-state shape — see docs/ANALYSIS.md "Dress rehearsal"), so
+    genesis build, per-block state persistence and the paged snapshot
+    all pay mainnet-shaped costs.  Composed invariants: liveness,
+    zero consensus sheds, no divergent honest heads, slashing
+    applied, governor engaged, resources stationary, kill recovered,
+    late joiner bootstrapped — plus the measured
+    ``snapshot_bootstrap_seconds`` / ``join_catchup_seconds`` /
+    ``heal``-class metrics in the BENCH ledger."""
+    rated = 250.0 if quick else 1000.0
+    return Scenario(
+        name="mainnet_rehearsal",
+        seed=73,
+        topology=Topology(
+            nodes=4, multikey=2, staking=True, external_validators=1,
+            blocks_per_epoch=4, durable=True, governor=True,
+            late_join=1, snapshot_threshold=4,
+            n_accounts=10_000, flat_root=True,
+            block_time_s=0.3,
+            phase_timeout_s=7.0 if quick else 10.0,
+            byzantine=(("s0n0", "double_vote"),),
+        ),
+        traffic=Traffic(
+            plain_rate=60.0 if quick else 150.0,
+            pop_rate=6.0, replay_workers=1,
+            node_pool_rate=rated * 10,
+            flood_duration_s=6.0 if quick else 12.0,
+        ),
+        phases=(
+            Phase(
+                "wan-matrix", at_s=0.0, duration_s=None,
+                links=("*->* rtt=50..150ms jitter=10ms loss=0.5%",),
+            ),
+            Phase(
+                # join once the network is provably past the snapshot
+                # threshold: the joiner must choose bootstrap, not
+                # replay (the invariant asserts it did)
+                "join-s0n4", at_round=5, duration_s=1.0,
+                joins=("s0n4",),
+            ),
+            Phase(
+                # mid-epoch (blocks_per_epoch=4: round 9 sits inside
+                # an epoch) torn-batch kill of the single-slot
+                # validator: quorum keeps one key of slack even with
+                # the joiner still catching up
+                "kill-s0n3-mid-commit", at_round=9, duration_s=1.0,
+                kills=(
+                    Kill("s0n3", mode="mid_commit",
+                         restart_after_s=4.0 if quick else 8.0),
+                ),
+            ),
+        ),
+        # the p99 bound is composition-shaped: rounds spanning the
+        # kill window or a WAN-lagged election boundary run the VC
+        # ladder by design — the SHARP assertions are the composed
+        # customs + zero sheds + liveness + no fork
+        invariants=Invariants(
+            min_blocks=11 if quick else 14,
+            round_p99_s=90.0,
+            min_epochs=2 if quick else 3,
+            custom=(
+                ("byz_evidence_applied", _byz_evidence_applied),
+                ("governor_engaged", _governor_engaged),
+                ("resources_bounded", _resources_bounded),
+                ("kills_recovered", _kills_recovered),
+                ("late_join_bootstrapped", _late_join_bootstrapped),
+            ),
+        ),
+        window_s=280.0 if quick else 520.0,
+    )
+
+
 SCENARIOS = {
     "view_change_storm": view_change_storm,
     "epoch_election_rotation": epoch_election_rotation,
@@ -1222,4 +1347,5 @@ SCENARIOS = {
     "asymmetric_partition": asymmetric_partition,
     "minority_partition_heal": minority_partition_heal,
     "wan_committee": wan_committee,
+    "mainnet_rehearsal": mainnet_rehearsal,
 }

@@ -26,10 +26,18 @@ from __future__ import annotations
 
 import os
 
+from .. import prof
 from . import rawdb
 from .state import StateDB
 
 _MAGIC = b"HTSNAP1\n"
+
+# wire-serving page shape: a page closes at whichever bound hits first.
+# Byte-bounded pages keep every frame far under the stream layer's
+# response cap even when single accounts are huge (validator wrappers
+# with long delegation lists)
+SNAPSHOT_PAGE_ACCOUNTS = 512
+SNAPSHOT_PAGE_BYTES = 4 * 1024 * 1024
 
 
 class SnapshotError(ValueError):
@@ -94,6 +102,45 @@ class _Reader:
         return out
 
 
+def paginate_state(blob: bytes,
+                   max_accounts: int = SNAPSHOT_PAGE_ACCOUNTS,
+                   max_bytes: int = SNAPSHOT_PAGE_BYTES) -> list:
+    """Partition a serialized StateDB blob (``[u32 n][(addr, account)
+    pairs]``) into wire pages: ``[(start_off, end_off, count), ...]``
+    covering the pair region exactly.  Page boundaries always fall on
+    account boundaries, so every page is itself a decodable
+    ``[u32 count] || pairs`` fragment once the count is prepended, and
+    the concatenation of all pages reassembles the original blob
+    byte-for-byte (the importer's root check then binds the exact
+    bytes).  Raises SnapshotError on a structurally damaged blob — the
+    walk is length-arithmetic only, no allocation."""
+    total = len(blob)
+    n = int.from_bytes(blob[:4], "little")
+    if n > total - 4:
+        raise SnapshotError("implausible account count in state blob")
+    off = 4
+    pages = []
+    start, count = off, 0
+    for _ in range(n):
+        ln = int.from_bytes(blob[off:off + 4], "little")
+        off += 4 + ln
+        if off + 4 > total:
+            raise SnapshotError("truncated state blob")
+        ln = int.from_bytes(blob[off:off + 4], "little")
+        off += 4 + ln
+        if off > total:
+            raise SnapshotError("truncated state blob")
+        count += 1
+        if count >= max_accounts or off - start >= max_bytes:
+            pages.append((start, off, count))
+            start, count = off, 0
+    if count:
+        pages.append((start, off, count))
+    if off != total:
+        raise SnapshotError("trailing bytes after state accounts")
+    return pages
+
+
 def export_snapshot(chain, path: str, num: int | None = None) -> int:
     """Write block ``num``'s (default: head) sealed state to ``path``.
 
@@ -101,24 +148,25 @@ def export_snapshot(chain, path: str, num: int | None = None) -> int:
     commit proof ([96B agg sig || bitmap], empty when the store has
     none, e.g. genesis) lets the importer's operator audit the seal.
     """
-    num = chain.head_number if num is None else num
-    header = rawdb.read_header(chain.db, num)
-    if header is None:
-        raise SnapshotError(f"no header {num}")
-    blob = rawdb.read_state(chain.db, header.root)
-    if blob is None:
-        raise SnapshotError(
-            f"no state for block {num} (pruned? export a newer block)"
-        )
-    proof = rawdb.read_commit_sig(chain.db, num) or b""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(_MAGIC)
-        f.write(_enc_blob(rawdb.encode_header(header)))
-        f.write(_enc_blob(proof))
-        f.write(_enc_blob(blob))
-    os.replace(tmp, path)
-    return num
+    with prof.stage("snapshot.export"):
+        num = chain.head_number if num is None else num
+        header = rawdb.read_header(chain.db, num)
+        if header is None:
+            raise SnapshotError(f"no header {num}")
+        blob = rawdb.read_state(chain.db, header.root)
+        if blob is None:
+            raise SnapshotError(
+                f"no state for block {num} (pruned? export a newer block)"
+            )
+        proof = rawdb.read_commit_sig(chain.db, num) or b""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(_enc_blob(rawdb.encode_header(header)))
+            f.write(_enc_blob(proof))
+            f.write(_enc_blob(blob))
+        os.replace(tmp, path)
+        return num
 
 
 def import_snapshot(chain, path: str, trust: bool = False) -> int:
@@ -153,39 +201,52 @@ def import_snapshot(chain, path: str, trust: bool = False) -> int:
             f"chain has no header {num}: import with trust=True only if "
             "the snapshot source is operator-trusted"
         )
+    return install_snapshot(chain, header, proof, state_blob)
 
-    state = StateDB.deserialize(state_blob)
-    if chain.config.state_root(state, header.epoch) != header.root:
-        raise SnapshotError(
-            "snapshot accounts do not match the sealed state root"
-        )
 
-    with chain._insert_lock:
-        # header + proof + state + head move in ONE atomic batch: a
-        # crash mid-import must leave the store exactly as damaged as
-        # before, never half-restored (same discipline as adopt_state)
-        from .kv import WriteBatch, commit_batch
-
-        batch = WriteBatch()
-        if local is None:
-            batch.put(
-                rawdb._num_key(rawdb._HEADER, num),
-                rawdb.encode_header(header),
+def install_snapshot(chain, header, proof: bytes,
+                     state_blob: bytes) -> int:
+    """Atomically install a snapshot whose HEADER the caller has
+    already established trust in (local-chain match, operator trust,
+    or — the late-join bootstrap — a peer-majority hash agreement).
+    The accounts are still bound here: they must hash to the header's
+    sealed state root, or nothing is written.  Returns the block
+    number."""
+    with prof.stage("snapshot.install"):
+        num = header.block_num
+        state = StateDB.deserialize(state_blob)
+        if chain.config.state_root(state, header.epoch) != header.root:
+            raise SnapshotError(
+                "snapshot accounts do not match the sealed state root"
             )
-            batch.put(rawdb._num_key(rawdb._CANON, num), header.hash())
-            batch.put(
-                rawdb._NUM_BY_HASH + header.hash(),
-                num.to_bytes(8, "little"),
-            )
-        if proof:
-            rawdb.write_commit_sig(batch, num, proof)
-        rawdb.write_state(batch, header.root, state_blob)
-        moves_head = num >= chain.head_number
-        if moves_head:
-            rawdb.write_head_number(batch, num)
-        commit_batch(chain.db, batch)
-        if moves_head:
-            chain._head_num = num
-            chain._state = state
-            chain._committee_cache.clear()
-    return num
+
+        with chain._insert_lock:
+            # header + proof + state + head move in ONE atomic batch: a
+            # crash mid-import must leave the store exactly as damaged
+            # as before, never half-restored (same discipline as
+            # adopt_state)
+            from .kv import WriteBatch, commit_batch
+
+            batch = WriteBatch()
+            if rawdb.read_header(chain.db, num) is None:
+                batch.put(
+                    rawdb._num_key(rawdb._HEADER, num),
+                    rawdb.encode_header(header),
+                )
+                batch.put(rawdb._num_key(rawdb._CANON, num), header.hash())
+                batch.put(
+                    rawdb._NUM_BY_HASH + header.hash(),
+                    num.to_bytes(8, "little"),
+                )
+            if proof:
+                rawdb.write_commit_sig(batch, num, proof)
+            rawdb.write_state(batch, header.root, state_blob)
+            moves_head = num >= chain.head_number
+            if moves_head:
+                rawdb.write_head_number(batch, num)
+            commit_batch(chain.db, batch)
+            if moves_head:
+                chain._head_num = num
+                chain._state = state
+                chain._committee_cache.clear()
+        return num

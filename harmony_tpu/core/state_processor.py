@@ -238,7 +238,7 @@ class StateProcessor:
             raise
         except (ValueError, KeyError, TypeError) as e:
             raise ExecutionError(f"{tx.directive.name}: {e}") from e
-        state._accounts = work._accounts
+        state.absorb(work)
         return Receipt(
             tx_hash=tx.hash(self.chain_id),
             status=1,

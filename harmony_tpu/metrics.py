@@ -280,6 +280,7 @@ class Registry:
         if prof:
             lines.append(prof)
         lines.append(self._aot_counters())
+        lines.append(self._snapshot_counters())
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -462,6 +463,22 @@ class Registry:
         from . import aot
 
         return aot.expose()
+
+    @staticmethod
+    def _snapshot_counters() -> str:
+        """Snapshot serve/bootstrap families (ISSUE 18 module
+        singletons): late-join bootstrap attempts by outcome, account
+        bytes installed, and responses served to joining peers — the
+        operator's answer to 'did the late joiner take the fast path,
+        and who is feeding it?'."""
+        from .p2p import stream as PS
+        from .sync import staged as SS
+
+        return "\n".join([
+            SS.SNAPSHOT_BOOTSTRAPS.expose(),
+            SS.SNAPSHOT_BYTES.expose(),
+            PS.SNAPSHOT_SERVED.expose(),
+        ])
 
     @staticmethod
     def _prof_counters() -> str:

@@ -30,6 +30,12 @@ from .. import trace
 from ..core import rawdb
 from ..core.types import _enc_bytes, _enc_int
 from ..core.types import Reader as _Reader
+from ..metrics import Counter
+
+SNAPSHOT_SERVED = Counter(
+    "harmony_snapshot_served_total",
+    "snapshot responses served to late-joining peers, by method",
+)
 
 PROTOCOL_VERSION = 1
 _HDR = struct.Struct("<IBQ")
@@ -43,8 +49,15 @@ METHOD_EPOCH_STATE = 4     # [u64 epoch] -> [encoded shard state | empty]
 METHOD_RECEIPTS = 5        # [u64 start][u32 count] -> per-block receipt blobs
 METHOD_ACCOUNT_RANGE = 6   # [u64 block][len-pfx start addr][u32 limit]
 #                            -> [u32 n][(addr, account blob)...]
+METHOD_SNAPSHOT_META = 7   # [u64 block (0 = latest)] -> empty |
+#                            [u64 num][u32 n_pages][u64 state_len]
+#                            [len-pfx header][len-pfx commit proof]
+METHOD_SNAPSHOT_PAGE = 8   # [u64 block][u32 page] -> empty |
+#                            [u32 count][(addr, account blob) pairs]
 MAX_BLOCKS_PER_REQUEST = 128   # server-side clamp
 MAX_ACCOUNTS_PER_REQUEST = 512  # account-range clamp
+MAX_SNAPSHOT_PAGES = 1_000_000   # client-side plausibility bound
+MAX_SNAPSHOT_STATE_BYTES = 1 << 30  # client assembles this in memory
 # wire plausibility bounds, checked BEFORE any allocation: every
 # request is a method byte + a handful of fixed fields (+ one short
 # address), and responses are assembled under the soft byte budget
@@ -64,6 +77,52 @@ def _checked_count(r: _Reader, width: int = 4) -> int:
     (a forged count must cost its own wire size, never a
     4-billion-iteration decode loop)."""
     return r.checked_count(width)
+
+
+def decode_snapshot_meta(resp: bytes):
+    """Pure decode of a METHOD_SNAPSHOT_META response body (module
+    level so the wire-fuzz tier drives it without a socket): ``(num,
+    n_pages, state_len, header_blob, proof)``, or None for the empty
+    not-serving response.  Both counts are plausibility-bounded BEFORE
+    the caller allocates anything against them — a hostile peer's meta
+    frame is the root of the whole download budget."""
+    if not resp:
+        return None
+    r = _Reader(resp)
+    num = r.int_()
+    n_pages = r.int_(4)
+    state_len = r.int_()
+    if n_pages > MAX_SNAPSHOT_PAGES:
+        raise ValueError(
+            f"implausible snapshot page count {n_pages}"
+        )
+    if state_len > MAX_SNAPSHOT_STATE_BYTES:
+        raise ValueError(
+            f"implausible snapshot state size {state_len}"
+        )
+    header_blob = r.bytes_()
+    proof = r.bytes_()
+    return num, n_pages, state_len, header_blob, proof
+
+
+def decode_snapshot_page(resp: bytes, num: int = 0) -> tuple:
+    """Pure decode of a METHOD_SNAPSHOT_PAGE response body:
+    ``(account_count, raw pair bytes)``.  The count is bounded by the
+    payload the peer actually paid to send; an empty body is the
+    protocol's typed not-serving signal (ConnectionError — the
+    downloader rotates peers or restarts with fresh meta)."""
+    if not resp:
+        raise ConnectionError(
+            f"peer no longer serves snapshot at block {num}"
+        )
+    count = int.from_bytes(resp[:4], "little")
+    payload = resp[4:]
+    if count > len(payload):
+        raise ValueError(
+            f"implausible snapshot page count {count} with "
+            f"{len(payload)} bytes"
+        )
+    return count, payload
 
 
 class SyncServer:
@@ -87,6 +146,14 @@ class SyncServer:
         # O(N^2/limit) in account count)
         self._range_cache: tuple | None = None
         self._range_lock = threading.Lock()
+        # snapshot-serving cache: one (num, header blob, proof, state
+        # blob, page offsets) entry — the page walk runs once per
+        # served block, every page request after that is a slice.
+        # Single-entry: concurrent importers at DIFFERENT blocks
+        # thrash it (one O(N) rewalk per flip), which is bounded and
+        # rare — a late joiner bootstraps once
+        self._snap_cache: tuple | None = None
+        self._snap_lock = threading.Lock()
         self._closing = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -192,6 +259,29 @@ class SyncServer:
                 if len(body) > RESPONSE_SOFT_BUDGET:
                     break  # short page: the client pages onward
             return bytes(_enc_int(n, 4) + body)
+        if method == METHOD_SNAPSHOT_META:
+            snap = self._snapshot(r.int_())
+            if snap is None:
+                return b""
+            SNAPSHOT_SERVED.inc(method="meta")
+            num, header_blob, proof, state_blob, pages = snap
+            return (
+                num.to_bytes(8, "little")
+                + len(pages).to_bytes(4, "little")
+                + len(state_blob).to_bytes(8, "little")
+                + _enc_bytes(header_blob) + _enc_bytes(proof)
+            )
+        if method == METHOD_SNAPSHOT_PAGE:
+            num = r.int_()
+            idx = r.int_(4)
+            snap = self._snapshot(num)
+            if snap is None or idx >= len(snap[4]):
+                return b""  # unknown/stale block or page out of range
+            _, _, _, state_blob, pages = snap
+            start_off, end_off, n = pages[idx]
+            SNAPSHOT_SERVED.inc(method="page")
+            return (n.to_bytes(4, "little")
+                    + state_blob[start_off:end_off])
         start = r.int_()
         count = min(r.int_(4), MAX_BLOCKS_PER_REQUEST)
         if method == METHOD_BLOCK_HASHES:
@@ -244,6 +334,36 @@ class SyncServer:
                 out += _enc_bytes(blob)
             return bytes(out)
         return b""
+
+    def _snapshot(self, num: int) -> tuple | None:
+        """The served snapshot at block ``num`` (0 = current head):
+        (num, header blob, commit proof, state blob, page offsets), or
+        None when the header/state is unknown or pruned.  Pages come
+        from core.snapshot.paginate_state over the stored serialized
+        state, so serving never deserializes accounts at all."""
+        from ..core.snapshot import SnapshotError, paginate_state
+
+        with self._snap_lock:
+            if num == 0:
+                num = self.chain.head_number
+            c = self._snap_cache
+            if c is not None and c[0] == num:
+                return c
+            header = rawdb.read_header(self.chain.db, num)
+            if header is None:
+                return None
+            state_blob = rawdb.read_state(self.chain.db, header.root)
+            if state_blob is None:
+                return None  # pruned past: client rotates peers
+            proof = rawdb.read_commit_sig(self.chain.db, num) or b""
+            try:
+                pages = paginate_state(state_blob)
+            except SnapshotError:
+                return None  # damaged local blob: don't serve garbage
+            c = (num, rawdb.encode_header(header), proof, state_blob,
+                 pages)
+            self._snap_cache = c
+            return c
 
     def close(self):
         self._closing = True
@@ -502,6 +622,31 @@ class SyncClient:
                 f"implausible account count {n} in sync response"
             )  # same bound as checked_count; n was already consumed
         return [(r.bytes_(), r.bytes_()) for _ in range(n)]
+
+    def get_snapshot_meta(self, num: int = 0, deadline=None):
+        """The peer's served snapshot at block ``num`` (0 = its head):
+        ``(num, n_pages, state_len, header_blob, proof)`` or None when
+        the peer has nothing to serve.  Every count is plausibility-
+        bounded BEFORE the caller allocates anything against it — the
+        meta frame is the root of the whole download budget."""
+        resp = self._call(
+            bytes([METHOD_SNAPSHOT_META]) + num.to_bytes(8, "little"),
+            deadline,
+        )
+        return decode_snapshot_meta(resp)
+
+    def get_snapshot_page(self, num: int, idx: int,
+                          deadline=None) -> tuple[int, bytes]:
+        """Page ``idx`` of the snapshot at block ``num``:
+        ``(account_count, raw pair bytes)``.  Raises ConnectionError
+        when the peer no longer serves that block (head moved, pruned)
+        so the downloader rotates or restarts with fresh meta."""
+        resp = self._call(
+            bytes([METHOD_SNAPSHOT_PAGE]) + num.to_bytes(8, "little")
+            + idx.to_bytes(4, "little"),
+            deadline,
+        )
+        return decode_snapshot_page(resp, num)
 
     def get_epoch_state(self, epoch: int, deadline=None):
         """The elected shard State recorded for ``epoch`` on the remote

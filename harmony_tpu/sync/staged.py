@@ -18,11 +18,21 @@ from collections import Counter
 from dataclasses import dataclass, field
 
 from ..log import get_logger
+from ..metrics import Counter as _MetricCounter
 from ..resilience import Deadline
 
 BATCH = 64  # blocks per fetch/verify window
 
 _log = get_logger("sync")
+
+SNAPSHOT_BOOTSTRAPS = _MetricCounter(
+    "harmony_snapshot_bootstrap_total",
+    "late-join snapshot bootstrap attempts, by outcome",
+)
+SNAPSHOT_BYTES = _MetricCounter(
+    "harmony_snapshot_bytes_total",
+    "account bytes installed via snapshot bootstrap",
+)
 
 
 @dataclass
@@ -39,7 +49,8 @@ class SyncResult:
 class Downloader:
     def __init__(self, chain, clients: list, batch: int = BATCH,
                  verify_seals: bool = True,
-                 request_deadline_s: float | None = None):
+                 request_deadline_s: float | None = None,
+                 snapshot_threshold: int | None = None):
         """clients: [SyncClient] — one per serving peer.  verify_seals
         routes through the chain engine's batched pairing check; False
         only for chains whose proofs were already consensus-verified.
@@ -48,12 +59,25 @@ class Downloader:
         stream's own 30 s default); a peer that times out or errors
         mid-stage is EXCLUDED for the rest of the pass and the stage
         completes from the remaining peers — one black-holed peer costs
-        one deadline, not one deadline per window."""
+        one deadline, not one deadline per window.
+
+        snapshot_threshold: when set and a sync pass finds this node
+        ``>= threshold`` blocks behind the network head, the pass first
+        bootstraps from a peer-served state snapshot (paged download,
+        root-verified, atomically installed) and only replays the tail
+        — the late-join path.  None (the default) keeps the classic
+        full-replay behavior."""
         self.chain = chain
         self.clients = list(clients)
         self.batch = batch
         self.verify_seals = verify_seals
         self.request_deadline_s = request_deadline_s
+        self.snapshot_threshold = snapshot_threshold
+        # late-join bootstrap telemetry (the chaos runner and the BENCH
+        # ledger read these)
+        self.snapshot_bootstraps = 0
+        self.last_snapshot_bootstrap_s: float | None = None
+        self.last_snapshot_block: int | None = None
         self._excluded: set = set()  # id(client), reset per pass
         self._lat: dict[int, float] = {}  # id(client) -> EWMA seconds
 
@@ -316,10 +340,163 @@ class Downloader:
         )
         return res
 
+    # -- stage: snapshot bootstrap (late join) ------------------------------
+
+    def _fetch_epoch_state(self, epoch: int):
+        """Majority-agreed shard state for ``epoch`` across peers (the
+        same trust base as agreed_hashes): the committee a late joiner
+        needs to seal-verify its replay tail, since the election blocks
+        that elected it are not replayed through a snapshot."""
+        from ..core import rawdb
+
+        votes: Counter = Counter()
+        decoded: dict[bytes, object] = {}
+        for c in self._peers():
+            try:
+                st = self._call(
+                    c, c.get_epoch_state, epoch,
+                    deadline=self._deadline(),
+                )
+            except (ConnectionError, OSError) as e:
+                self._exclude(c, "epoch-state", e)
+                continue
+            if st is None:
+                continue
+            enc = rawdb.encode_shard_state(st)
+            votes[enc] += 1
+            decoded[enc] = st
+        if not votes:
+            return None
+        return decoded[votes.most_common(1)[0][0]]
+
+    def _download_snapshot_pages(self, first_peer, num: int,
+                                 n_pages: int, state_len: int):
+        """All pages of the snapshot at block ``num``, resumable: a
+        page that fails on one peer retries on the others at the SAME
+        index (pages are canonical slices of one sealed serialization,
+        so any peer still serving that block continues the download).
+        Returns (total_account_count, [page bytes]) or None."""
+        parts: list[bytes] = []
+        total_accounts = 0
+        total_bytes = 0
+        for idx in range(n_pages):
+            page = None
+            peers = [first_peer] + [
+                c for c in self._peers() if c is not first_peer
+            ]
+            for c in peers:
+                if id(c) in self._excluded:
+                    continue
+                try:
+                    page = self._call(
+                        c, c.get_snapshot_page, num, idx,
+                        deadline=self._deadline(),
+                    )
+                    break
+                except (ConnectionError, OSError, ValueError) as e:
+                    self._exclude(c, "snapshot", e)
+                    continue
+            if page is None:
+                return None  # no peer serves this page any more
+            count, payload = page
+            total_accounts += count
+            total_bytes += len(payload)
+            if total_bytes > state_len:
+                # the pages exceed what the meta promised: hostile or
+                # inconsistent serving — abandon this snapshot
+                return None
+            parts.append(payload)
+        return total_accounts, parts
+
+    def _snapshot_bootstrap(self, target: int) -> bool:
+        """Install a peer-served snapshot as the new local head.  Trust
+        chain: the snapshot header's hash must match the per-height
+        PEER MAJORITY (agreed_hashes — the same cross-peer check every
+        staged window gets), and the accounts must hash to that
+        header's sealed state root (install_snapshot).  Returns True
+        when the local head moved."""
+        from ..core import rawdb
+        from ..core.snapshot import SnapshotError, install_snapshot
+
+        t0 = time.monotonic()
+        for c in self._peers():
+            try:
+                meta = self._call(
+                    c, c.get_snapshot_meta, deadline=self._deadline(),
+                )
+            except (ConnectionError, OSError, ValueError) as e:
+                self._exclude(c, "snapshot", e)
+                continue
+            if meta is None:
+                continue
+            num, n_pages, state_len, header_blob, proof = meta
+            if num <= self.chain.head_number or num > target:
+                continue  # stale or past-the-horizon snapshot
+            try:
+                header = rawdb.decode_header(header_blob)
+            except (ValueError, IndexError) as e:
+                self._exclude(c, "snapshot", e)
+                continue
+            if header.block_num != num:
+                self._exclude(c, "snapshot", "header/number mismatch")
+                continue
+            agreed = self.agreed_hashes(num, 1)
+            if not agreed or agreed[0] != header.hash():
+                SNAPSHOT_BOOTSTRAPS.inc(outcome="header_rejected")
+                self._exclude(
+                    c, "snapshot", "header not in the majority chain"
+                )
+                continue
+            got = self._download_snapshot_pages(
+                c, num, n_pages, state_len
+            )
+            if got is None:
+                SNAPSHOT_BOOTSTRAPS.inc(outcome="pages_abandoned")
+                continue
+            total_accounts, parts = got
+            blob = (total_accounts.to_bytes(4, "little")
+                    + b"".join(parts))
+            try:
+                install_snapshot(self.chain, header, proof, blob)
+            except (SnapshotError, ValueError) as e:
+                SNAPSHOT_BOOTSTRAPS.inc(outcome="install_failed")
+                self._exclude(c, "snapshot", e)
+                continue
+            # the committee context the replay tail will verify seals
+            # against: elections inside the snapshot's past are not
+            # replayed, so their outcome is fetched (majority-agreed)
+            epoch = self.chain.epoch_of(num)
+            for ep in {epoch, epoch + 1}:
+                if rawdb.read_shard_state(self.chain.db, ep) is None:
+                    st = self._fetch_epoch_state(ep)
+                    if st is not None:
+                        rawdb.write_shard_state(self.chain.db, ep, st)
+                        self.chain._committee_cache.pop(ep, None)
+            self.snapshot_bootstraps += 1
+            self.last_snapshot_bootstrap_s = time.monotonic() - t0
+            self.last_snapshot_block = num
+            SNAPSHOT_BOOTSTRAPS.inc(outcome="done")
+            SNAPSHOT_BYTES.inc(len(blob))
+            _log.info(
+                "snapshot bootstrap done", block=num, pages=n_pages,
+                accounts=total_accounts,
+                seconds=round(self.last_snapshot_bootstrap_s, 3),
+            )
+            return True
+        return False
+
     def sync_once(self) -> SyncResult:
         """One pass to the current network head."""
         self._excluded.clear()  # every peer gets a fresh chance per pass
         res = SyncResult(target=self.network_head())
+        behind = res.target - self.chain.head_number
+        if (self.snapshot_threshold is not None
+                and behind >= self.snapshot_threshold):
+            head0 = self.chain.head_number
+            if self._snapshot_bootstrap(res.target):
+                res.inserted += self.chain.head_number - head0
+            # bootstrap failure is not a pass failure: the classic
+            # replay below still makes progress, just slowly
         if res.target > self.chain.head_number:
             _log.info(
                 "sync start", head=self.chain.head_number,

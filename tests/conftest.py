@@ -51,6 +51,16 @@ if os.environ.get("OPS_INPROC") != "1":
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` budget "
+        "(10^5-account profiling runs; check.sh runs them in a "
+        "dedicated stage)",
+    )
+
+
 _EXIT_STATUS = [0]
 
 

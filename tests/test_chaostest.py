@@ -147,6 +147,7 @@ def test_scenario_registry_names_and_shape():
         "overload_storm", "wedged_thread_recovery",
         "gray_leader", "asymmetric_partition",
         "minority_partition_heal", "wan_committee",
+        "mainnet_rehearsal",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):

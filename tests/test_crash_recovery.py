@@ -392,6 +392,74 @@ def test_restarted_validator_cannot_double_sign(tmp_path, monkeypatch):
     val2.chain.db.close()
 
 
+def test_snapshot_import_crash_matrix(tmp_path):
+    """ISSUE 18: SIGKILL at EVERY kv.commit fault point of a snapshot
+    import leaves a store that reopens to either the pre-import head or
+    the complete snapshot — never a half-imported state (a header
+    without its accounts, a head pointer past its state)."""
+    import shutil
+
+    from harmony_tpu.core import snapshot as SN
+
+    genesis, _, _ = dev_genesis()
+    src = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    _grow(src, 5)
+    snap = str(tmp_path / "head.snap")
+    assert SN.export_snapshot(src, snap) == 5
+    src_root = src.state().root()
+
+    # the importer has its own 2-block history on disk
+    path = str(tmp_path / "import.kv")
+    chain = _open(path, genesis)
+    _grow(chain, 2)
+    pre_root = chain.state().root()
+    chain.db.close()
+
+    # enumerate this import's crash points with a counting-only rule
+    probe = str(tmp_path / "probe.kv")
+    shutil.copyfile(path, probe)
+    FI.arm("kv.commit", key="__none__", after=10**9)
+    c = Blockchain(FileKV(probe), genesis, blocks_per_epoch=16)
+    before = FI.hits("kv.commit")
+    assert SN.import_snapshot(c, snap, trust=True) == 5
+    points = FI.hits("kv.commit") - before
+    assert points >= 3  # BEGIN + records + COMMIT at minimum
+    c.db.close()
+    FI.reset()
+
+    outcomes = set()
+    for k in range(points):
+        p = str(tmp_path / f"snapfp{k}.kv")
+        shutil.copyfile(path, p)
+        c = Blockchain(FileKV(p), genesis, blocks_per_epoch=16)
+        FI.reset()
+        FI.arm("kv.commit", key=p, after=k, times=1)
+        with pytest.raises(FI.FaultInjected):
+            SN.import_snapshot(c, snap, trust=True)
+        FI.reset()
+        # abandon without close (unbuffered writes = SIGKILL state)
+        r = Blockchain(FileKV(p), genesis, blocks_per_epoch=16)
+        head = r.head_number
+        assert head in (2, 5), (
+            f"fault point {k}: half-imported head {head}"
+        )
+        if head == 5:
+            # the import went fully durable before the kill
+            assert r.state().root() == src_root
+            assert r.read_commit_sig(5) is not None
+        else:
+            # the import vanished whole: the old chain still extends
+            assert r.state().root() == pre_root
+            assert r.insert_chain(
+                [Worker(r, None).propose_block(view_id=3)],
+                commit_sigs=[_proof(r, 3)], verify_seals=False,
+            ) == 1
+        outcomes.add(head)
+        r.db.close()
+    # the matrix exercised the pre-commit side at minimum
+    assert 2 in outcomes
+
+
 def test_adopt_state_moves_head_and_state_together(tmp_path):
     """Fast-sync completion: a crash between the state write and the
     head move must never strand a head without state — they commit in

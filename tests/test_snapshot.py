@@ -148,6 +148,160 @@ def test_snapshot_import_fresh_node_requires_trust(tmp_path):
     assert fresh.state().root() == chain.state().root()
 
 
+# -- ISSUE 18: the serve -> late-join import path at mainnet-ish size --------
+
+
+def test_snapshot_serve_import_roundtrip_10k():
+    """Export -> serve -> import at 10^4 accounts: the late joiner's
+    snapshot bootstrap lands on the exact sealed state, tail replay
+    re-derives cross-shard receipts, and the genesis build time guards
+    the de-quadratic'd allocation/root paths."""
+    import time
+
+    from harmony_tpu.core import rawdb as RD
+    from harmony_tpu.node.cross_shard import export_receipts
+    from harmony_tpu.p2p.stream import SyncClient, SyncServer
+    from harmony_tpu.sync.staged import Downloader
+
+    t0 = time.monotonic()
+    genesis, keys, _ = dev_genesis(n_accounts=10_000, flat_root=True)
+    build_s = time.monotonic() - t0
+    # regression guard: the pre-PR-18 O(N^2) root/alloc paths took
+    # minutes here; the linear paths take ~2s on a loaded box
+    assert build_s < 15.0, f"dev_genesis(10k) took {build_s:.1f}s"
+
+    serving = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+    _grow(serving, keys, 3)
+
+    srv = SyncServer(serving)
+    try:
+        joiner = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        dl = Downloader(joiner, [SyncClient(srv.port)], batch=2,
+                        verify_seals=False, snapshot_threshold=2)
+        dl.sync_once()
+        assert dl.snapshot_bootstraps == 1
+        assert dl.last_snapshot_bootstrap_s is not None
+        assert joiner.head_number == 3
+        assert (joiner.current_header().hash()
+                == serving.current_header().hash())
+        assert joiner.state().root() == serving.state().root()
+
+        # the tail above the snapshot: a cross-shard tx whose receipts
+        # the joiner must re-derive during replay
+        pool = TxPool(CHAIN_ID, 0, serving.state)
+        worker = Worker(serving, pool)
+        pool.add(Transaction(
+            nonce=3, gas_price=1, gas_limit=25_000, shard_id=0,
+            to_shard=1, to=b"\x0c" * 20, value=777,
+        ).sign(keys[0], CHAIN_ID))
+        block = worker.propose_block(view_id=4)
+        serving.insert_chain([block], verify_seals=False)
+        dl.sync_once()
+        assert joiner.head_number == 4
+        assert joiner.state().root() == serving.state().root()
+        want = RD.read_receipts(serving.db, 4)
+        assert want  # the cx tx produced a receipt
+        assert RD.read_receipts(joiner.db, 4) == want
+        assert (export_receipts(joiner, 4, shard_count=2)
+                == export_receipts(serving, 4, shard_count=2))
+    finally:
+        srv.close()
+
+
+def test_snapshot_import_preserves_cx_marks(tmp_path):
+    """An import on a store with history must not clobber its
+    cross-shard spent marks or receipts — the destination shard's
+    double-spend ledger survives a snapshot restore."""
+    from harmony_tpu.core import rawdb as RD
+    from harmony_tpu.core.genesis import Genesis
+    from harmony_tpu.node.cross_shard import export_receipts
+
+    g0, keys, _ = _GENESIS
+    g1 = Genesis(config=g0.config, shard_id=1, alloc=dict(g0.alloc),
+                 committee=list(g0.committee))
+    c0 = Blockchain(MemKV(), g0, blocks_per_epoch=16)
+    c1 = Blockchain(MemKV(), g1, blocks_per_epoch=16)
+
+    pool = TxPool(CHAIN_ID, 0, c0.state)
+    pool.add(Transaction(
+        nonce=0, gas_price=1, gas_limit=25_000, shard_id=0,
+        to_shard=1, to=b"\x0c" * 20, value=555,
+    ).sign(keys[0], CHAIN_ID))
+    b0 = Worker(c0, pool).propose_block(view_id=1)
+    assert c0.insert_chain([b0], verify_seals=False) == 1
+    proofs = export_receipts(c0, 1, shard_count=2)
+    b1 = Worker(c1, None).propose_block(
+        view_id=1, incoming_receipts=[proofs[1]]
+    )
+    assert c1.insert_chain([b1], verify_seals=False) == 1
+    assert RD.is_cx_spent(c1.db, 0, 1)
+
+    path = str(tmp_path / "s1.snap")
+    assert SN.export_snapshot(c1, path) == 1
+    # damage: head state pruned away (the restore-after-prune shape)
+    rawdb.delete_state(c1.db, c1.current_header().root)
+    assert SN.import_snapshot(c1, path) == 1
+    assert c1.state().balance(b"\x0c" * 20) == 555
+    # the spent marks were never part of the batch: intact
+    assert RD.is_cx_spent(c1.db, 0, 1)
+    assert RD.cx_spender(c1.db, 0, 1) == 1
+
+    # same restore on the SOURCE shard: its outgoing receipts (the
+    # proof material other shards may still request) survive too
+    path0 = str(tmp_path / "s0.snap")
+    assert SN.export_snapshot(c0, path0) == 1
+    rawdb.delete_state(c0.db, c0.current_header().root)
+    assert SN.import_snapshot(c0, path0) == 1
+    assert RD.read_receipts(c0.db, 1)
+    assert export_receipts(c0, 1, shard_count=2) == proofs
+
+
+@pytest.mark.slow
+def test_snapshot_budget_100k_profiled():
+    """ISSUE 18 acceptance: the 10^5-account genesis builds and
+    snapshot-imports inside the scenario budget, with prof.stage()
+    histograms over the build/root/export/install paths (the numbers
+    quoted in docs/ANALYSIS.md § Dress rehearsal)."""
+    import time
+
+    from harmony_tpu import prof
+
+    prof.reset()
+    prof.configure(enabled=True)
+    try:
+        t0 = time.monotonic()
+        genesis, keys, _ = dev_genesis(n_accounts=100_000,
+                                       flat_root=True)
+        build_s = time.monotonic() - t0
+        assert build_s < 120.0, f"dev_genesis(100k) {build_s:.1f}s"
+
+        chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        _grow(chain, keys, 1)
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = d + "/big.snap"
+            t0 = time.monotonic()
+            assert SN.export_snapshot(chain, path) == 1
+            fresh = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+            assert SN.import_snapshot(fresh, path, trust=True) == 1
+            roundtrip_s = time.monotonic() - t0
+        assert roundtrip_s < 120.0, f"roundtrip {roundtrip_s:.1f}s"
+        assert fresh.state().root() == chain.state().root()
+
+        summary = prof.stage_summary()
+        for stage in ("genesis.build_state", "state.root",
+                      "snapshot.export", "snapshot.install"):
+            assert stage in summary, f"stage {stage} not recorded"
+        # surfaced for the ANALYSIS.md table (pytest -s)
+        for name, s in sorted(summary.items()):
+            print(f"  {name}: n={s['count']} sum={s['sum_s']:.3f}s "
+                  f"p50={s['p50_s']:.3f}s p99={s['p99_s']:.3f}s")
+    finally:
+        prof.reset()
+
+
 def test_pruned_node_resyncs_history_state(tmp_path):
     """prune -> restart -> resync (VERDICT r4 #7 done-criterion): a
     pruned node re-acquires a historical state through the fast-sync

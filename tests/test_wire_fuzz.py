@@ -296,6 +296,114 @@ def test_receipt_log_and_topic_count_inflation_rejected_fast():
     assert time.monotonic() - t0 < 0.1
 
 
+# -- ISSUE 18: the snapshot-serving codec (late-join bootstrap path) ---------
+#
+# The meta frame is the root of the download budget (a hostile peer's
+# forged n_pages/state_len must die before any allocation); page frames
+# carry raw pair bytes whose count is bounded by what the peer actually
+# paid to send; paginate_state walks operator/peer state blobs with
+# length arithmetic only.
+
+
+def _snapshot_state_blob(n_accounts: int = 20) -> bytes:
+    from harmony_tpu.core.state import Account, StateDB
+
+    return StateDB({
+        bytes([i]) * 20: Account(balance=10**18 + i, nonce=i)
+        for i in range(n_accounts)
+    }).serialize()
+
+
+def test_fuzz_snapshot_meta_decoder():
+    from harmony_tpu.p2p import stream as ST
+
+    base = (
+        (42).to_bytes(8, "little")          # block num
+        + (3).to_bytes(4, "little")         # n_pages
+        + (4096).to_bytes(8, "little")      # state_len
+        + (80).to_bytes(4, "little") + b"\x07" * 80   # header blob
+        + (108).to_bytes(4, "little") + b"\x08" * 108  # commit proof
+    )
+    assert ST.decode_snapshot_meta(base) is not None
+    _fuzz(ST.decode_snapshot_meta, base)
+
+
+def test_fuzz_snapshot_page_decoder():
+    from harmony_tpu.p2p import stream as ST
+
+    blob = _snapshot_state_blob()
+    base = (20).to_bytes(4, "little") + blob[4:]
+    assert ST.decode_snapshot_page(base)[0] == 20
+
+    def decode(buf: bytes):
+        try:
+            ST.decode_snapshot_page(buf)
+        except ConnectionError:
+            pass  # empty body = the typed not-serving signal
+
+    _fuzz(decode, base)
+
+
+def test_fuzz_paginate_state():
+    from harmony_tpu.core.snapshot import SnapshotError, paginate_state
+
+    blob = _snapshot_state_blob()
+    pages = paginate_state(blob, max_accounts=4)
+    assert sum(c for _, _, c in pages) == 20
+    assert issubclass(SnapshotError, ValueError)
+    _fuzz(lambda b: paginate_state(b, max_accounts=4), blob)
+
+
+def test_snapshot_meta_count_inflation_rejected_fast():
+    """A peer forging a 4-billion page count (or a 2^60 state size)
+    must get a typed rejection in microseconds — before the downloader
+    sizes ANY structure against it."""
+    from harmony_tpu.p2p import stream as ST
+
+    base = bytearray(
+        (42).to_bytes(8, "little") + (3).to_bytes(4, "little")
+        + (4096).to_bytes(8, "little")
+        + (4).to_bytes(4, "little") + b"\x07" * 4
+        + (0).to_bytes(4, "little")
+    )
+    struct.pack_into("<I", base, 8, 0xFFFFFFF0)  # n_pages
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="implausible"):
+        ST.decode_snapshot_meta(bytes(base))
+    assert time.monotonic() - t0 < 0.1
+
+    base = bytearray(base)
+    struct.pack_into("<I", base, 8, 3)           # restore n_pages
+    struct.pack_into("<Q", base, 12, 1 << 60)    # state_len
+    with pytest.raises(ValueError, match="implausible"):
+        ST.decode_snapshot_meta(bytes(base))
+
+
+def test_snapshot_page_count_inflation_rejected_fast():
+    from harmony_tpu.p2p import stream as ST
+
+    base = bytearray((2).to_bytes(4, "little") + b"\x01" * 64)
+    struct.pack_into("<I", base, 0, 0xFFFFFFF0)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="implausible"):
+        ST.decode_snapshot_page(bytes(base))
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_paginate_state_count_inflation_rejected_fast():
+    """A corrupted state blob forging the leading account count walks
+    ZERO accounts before the typed rejection (the walk is length
+    arithmetic, no allocation)."""
+    from harmony_tpu.core.snapshot import SnapshotError, paginate_state
+
+    blob = bytearray(_snapshot_state_blob())
+    struct.pack_into("<I", blob, 0, 0xFFFFFFF0)
+    t0 = time.monotonic()
+    with pytest.raises(SnapshotError, match="implausible"):
+        paginate_state(bytes(blob))
+    assert time.monotonic() - t0 < 0.1
+
+
 def test_stored_batch_count_inflation_rejected_fast():
     """A corrupted (or crash-torn) store blob forging the leading
     batch count must raise, not spin garbage-object loops."""

@@ -42,6 +42,14 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", action="append", default=None,
                     help="run only this scenario (repeatable); default "
                          "all five")
+    ap.add_argument("--only", default=None, metavar="SUBSTR",
+                    help="filter the scenario list (exact name or "
+                         "case-insensitive substring) — composes with "
+                         "--scenario")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override every selected scenario's baked-in "
+                         "seed (keys, fixtures, netem draws and garble "
+                         "bytes all re-derive from it)")
     ap.add_argument("--quick", action="store_true",
                     help="reduced durations/targets (the CI stage "
                          "budget); same topology, faults, invariants")
@@ -66,10 +74,25 @@ def main(argv=None) -> int:
         print(f"chaos_sweep: unknown scenario(s) {unknown}; "
               f"known: {sorted(SCENARIOS)}", file=sys.stderr)
         return 2
+    if args.only is not None:
+        needle = args.only.lower()
+        names = [
+            n for n in names
+            if n == args.only or needle in n.lower()
+        ]
+        if not names:
+            print(f"chaos_sweep: --only {args.only!r} matches no "
+                  f"scenario; known: {sorted(SCENARIOS)}",
+                  file=sys.stderr)
+            return 2
 
     results = []
     for name in names:
         scenario = SCENARIOS[name](quick=args.quick)
+        if args.seed is not None:
+            import dataclasses
+
+            scenario = dataclasses.replace(scenario, seed=args.seed)
         print(f"chaos_sweep: running {name} "
               f"(seed={scenario.seed}, window={scenario.window_s:g}s, "
               f"{len(scenario.phases)} fault phase(s))...",

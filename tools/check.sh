@@ -109,6 +109,19 @@
 #      matrix; round p99 in the ledger); chaos_*/netem_* metrics
 #      land as an ephemeral BENCH round gated by bench_ledger
 #      --check.
+#  12. mainnet rehearsal — the composed dress rehearsal (ISSUE 18):
+#      the snapshot / large-genesis unit tiers (export -> serve ->
+#      import roundtrip at 10^4 accounts with a dev_genesis build-time
+#      regression bound, the snapshot-import kv.commit crash matrix,
+#      snapshot-codec wire-fuzz + inflation fast-fail), then
+#      mainnet_rehearsal via chaos_sweep --quick --check — EVERY
+#      hardening axis in one run (whole-window WAN matrix + staked
+#      Byzantine double-voter + 10x overload flood + mid-commit
+#      kill/restart-from-disk + epoch elections + a late-joining node
+#      bootstrapping from a peer-served snapshot) judged by the
+#      composed invariant set; rehearsal metrics
+#      (snapshot_bootstrap_seconds, join_catchup_seconds, ...) land
+#      as an ephemeral BENCH round gated by bench_ledger --check.
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -178,7 +191,8 @@ CRASH_ROUND="$(mktemp)"
 BYZ_ROUND="$(mktemp)"
 SOAK_ROUND="$(mktemp)"
 NETEM_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND" "$NETEM_ROUND"' EXIT
+REHEARSAL_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND" "$NETEM_ROUND" "$REHEARSAL_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --scenario view_change_storm --scenario epoch_election_rotation \
   --scenario cross_shard_partition --scenario validator_churn \
@@ -241,5 +255,16 @@ JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --bench-out "$NETEM_ROUND" --bench-round 995 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$NETEM_ROUND" > /dev/null
+
+echo "== mainnet rehearsal: snapshot tiers + every axis composed =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_snapshot.py \
+  tests/test_crash_recovery.py
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --only mainnet_rehearsal \
+  --bench-out "$REHEARSAL_ROUND" --bench-round 994 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$REHEARSAL_ROUND" > /dev/null
 
 echo "check.sh: OK"
