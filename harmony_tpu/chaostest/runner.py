@@ -1161,6 +1161,11 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         dump_cooldown_s=2.0,  # distinct anomaly kinds per violation;
         # the cooldown only throttles repeats of one kind
     )
+    # replay-stage histograms are process-cumulative; snapshot now so
+    # the metric assembly below reports THIS run's delta
+    from ..obs import replay as obs_replay
+
+    replay_base = obs_replay.snapshot()
     DV.use_device(True)
     sheds_before = _consensus_sheds()
     fi_points = ("device.dispatch", "sidecar.call", "sidecar.frame",
@@ -1451,6 +1456,37 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         "run_s": _m(round(run_s, 2), "s",
                     window_s=scenario.window_s),
     }
+    # per-phase round attribution (ISSUE 19): the run's spans are
+    # still live in the store (reset happens at the NEXT run's start),
+    # so stitch committed rounds into timelines here — a kernel or
+    # aggregation PR gets a before/after per phase, not just a p99
+    from ..obs import build_timelines, observe_timelines
+
+    tls = [t for t in build_timelines(trace.spans()) if t.committed]
+    phase_summary = observe_timelines(tls)
+    if tls:
+        total_wall = sum(t.wall_s for t in tls)
+        attributed = sum(sum(t.phases.values()) for t in tls)
+        metrics["round_phase_attributed_ratio"] = _m(
+            round(attributed / total_wall, 4) if total_wall else None,
+            "ratio", rounds=len(tls), derived_from="round_timeline",
+        )
+        for phase, total_s in phase_summary["phase_seconds"].items():
+            vals = sorted(t.phases[phase] for t in tls
+                          if phase in t.phases)
+            metrics[f"round_phase_{phase}_s"] = _m(
+                round(vals[len(vals) // 2], 4), "s",
+                rounds=len(vals), total_s=round(total_s, 3),
+                derived_from="round_timeline",
+            )
+    # replay-stage burn-down: per-stage quantiles of THIS run's
+    # observations (delta against the start-of-run snapshot)
+    for stage_name, q in obs_replay.quantiles_since(replay_base).items():
+        metrics[f"replay_stage_{stage_name}_s"] = _m(
+            q.get("p50_s"), "s", count=q["count"],
+            sum_s=q["sum_s"], p99_s=q.get("p99_s"),
+            derived_from="stage_histogram",
+        )
     netem = env.net.netem
     if netem is not None and netem.ever_armed:
         tot = netem.totals()

@@ -95,6 +95,8 @@ DEFAULTS = {
     "trace_sample": 1.0,   # root-span sampling rate [0, 1]
     "trace_slo": None,     # round-latency SLO seconds (None = off)
     "trace_dir": None,     # dump dir ($HARMONY_TPU_TRACE_DIR/<tmp>)
+    "span_sink_dir": None,  # durable JSONL span export (implies trace;
+    # merge the per-node files with tools/round_forensics.py)
     # startup AOT warmup: precompile every compile-manifest program
     # (GL16's machine-checked shape set) before the node serves, so no
     # serving path ever pays a first-use XLA compile (the PR-15
@@ -260,7 +262,8 @@ def build_node(cfg: dict):
     """Wire every subsystem; returns (node, services, registry)."""
     os.makedirs(cfg["datadir"], exist_ok=True)
 
-    if cfg.get("trace"):
+    span_sink = None
+    if cfg.get("trace") or cfg.get("span_sink_dir"):
         from . import trace as TR
 
         # explicit None checks: --trace-sample 0 is a valid rate
@@ -273,6 +276,17 @@ def build_node(cfg: dict):
             round_slo_s=cfg.get("trace_slo"),
             dump_dir=cfg.get("trace_dir"),
         )
+        # one real node per process: every span this process creates is
+        # attributable when sink files from several nodes merge (the
+        # TCPHost naming convention, unique across a localnet)
+        node_label = f"shard{cfg['shard_id']}-{os.getpid()}"
+        TR.set_node(node_label)
+        if cfg.get("span_sink_dir"):
+            from .obs import SpanSink
+
+            span_sink = SpanSink(
+                cfg["span_sink_dir"], node=node_label
+            ).arm()
 
     genesis, dev_bls = _open_genesis(cfg)
     db = _open_db(cfg)
@@ -403,6 +417,14 @@ def build_node(cfg: dict):
         ServiceType.PROMETHEUS,
         _CallbackService(metrics.start, metrics.stop),
     )
+
+    if span_sink is not None:
+        # armed eagerly above (boot spans export too); the service
+        # slot flushes and unhooks it on shutdown
+        manager.register(
+            ServiceType.SPAN_SINK,
+            _CallbackService(lambda: None, span_sink.close),
+        )
 
     if cfg.get("pprof_port") is not None:
         # reference: api/service/pprof behind cmd/harmony --pprof
@@ -604,6 +626,10 @@ def main(argv=None):
                         "dumps a flight-recorder snapshot")
     p.add_argument("--trace-dir", dest="trace_dir",
                    help="flight-recorder dump directory")
+    p.add_argument("--span-sink-dir", dest="span_sink_dir",
+                   help="durable span export: write every finished "
+                        "span as JSONL under this directory (implies "
+                        "--trace; analyze with tools/round_forensics.py)")
     p.add_argument("--device-verify", dest="device_verify",
                    action="store_const", const=True, default=None,
                    help="force the TPU verification path")

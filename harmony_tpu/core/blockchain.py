@@ -20,6 +20,7 @@ import threading
 
 from ..chain.header import Header
 from ..log import get_logger
+from ..obs.replay import stage as replay_stage
 from .genesis import Genesis
 from .kv import WriteBatch, commit_batch
 from .state import StateDB
@@ -584,7 +585,10 @@ class Blockchain:
                 sig, bitmap = proof[:96], proof[96:]
                 items.append((block.header, sig, bitmap))
                 flags.append(self.config.is_staking(block.header.epoch))
-            ok = self.engine.verify_headers_batch(items, flags, lane=lane)
+            with replay_stage("seal_verify", blocks=len(items)):
+                ok = self.engine.verify_headers_batch(
+                    items, flags, lane=lane
+                )
             for block, good in zip(blocks, ok):
                 if not good:
                     raise ChainError(
@@ -792,32 +796,40 @@ class Blockchain:
         intact, never a block without its state or proof."""
         inserted = 0
         for block, proof in zip(blocks, proofs):
-            spent_keys = self.verify_incoming_receipts(block)
-            state, result, elected = self._execute(block)
-            batch = WriteBatch()
-            for from_shard, num in spent_keys:
-                rawdb.write_cx_spent(
-                    batch, from_shard, num, spender=block.block_num
+            with replay_stage("execute", block=block.block_num):
+                spent_keys = self.verify_incoming_receipts(block)
+                state, result, elected = self._execute(block)
+            with replay_stage("kv_commit", block=block.block_num):
+                batch = WriteBatch()
+                for from_shard, num in spent_keys:
+                    rawdb.write_cx_spent(
+                        batch, from_shard, num, spender=block.block_num
+                    )
+                if elected is not None:
+                    rawdb.write_shard_state(
+                        batch, elected.epoch, elected
+                    )
+                rawdb.write_block(batch, block, self.config.chain_id)
+                rawdb.write_state(
+                    batch, block.header.root, state.serialize()
                 )
-            if elected is not None:
-                rawdb.write_shard_state(batch, elected.epoch, elected)
-            rawdb.write_block(batch, block, self.config.chain_id)
-            rawdb.write_state(batch, block.header.root, state.serialize())
-            rawdb.write_receipts(
-                batch, block.block_num,
-                result.receipts + result.staking_receipts,
-            )
-            if proof is not None:
-                rawdb.write_commit_sig(batch, block.block_num, proof)
-            by_shard: dict[int, list] = {}
-            for cx in result.outgoing_cx:
-                by_shard.setdefault(cx.to_shard, []).append(cx)
-            for to_shard, cxs in by_shard.items():
-                rawdb.write_outgoing_cx(
-                    batch, to_shard, block.block_num, cxs
+                rawdb.write_receipts(
+                    batch, block.block_num,
+                    result.receipts + result.staking_receipts,
                 )
-            rawdb.write_head_number(batch, block.block_num)
-            commit_batch(self.db, batch)
+                if proof is not None:
+                    rawdb.write_commit_sig(
+                        batch, block.block_num, proof
+                    )
+                by_shard: dict[int, list] = {}
+                for cx in result.outgoing_cx:
+                    by_shard.setdefault(cx.to_shard, []).append(cx)
+                for to_shard, cxs in by_shard.items():
+                    rawdb.write_outgoing_cx(
+                        batch, to_shard, block.block_num, cxs
+                    )
+                rawdb.write_head_number(batch, block.block_num)
+                commit_batch(self.db, batch)
             if elected is not None:
                 self._committee_cache.pop(elected.epoch, None)
             if self.state_retention:

@@ -15,6 +15,8 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import trace as _TR  # stdlib-only; log.py already imports it
+
 
 def process_sample() -> dict:
     """Live process resources, stdlib-only (no psutil): RSS and thread
@@ -163,19 +165,29 @@ class Histogram:
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self.labels = dict(labels or {})
         self._counts = [0] * (len(self.buckets) + 1)
+        # bucket index -> (trace_id, value): the LAST traced
+        # observation that landed in each bucket — bounded by the
+        # bucket count, so a p99 outlier links straight to the trace
+        # that produced it (OpenMetrics exemplars)
+        self._exemplars: dict = {}
         self._sum = 0.0
         self._total = 0
         self._lock = threading.Lock()
 
     def observe(self, value: float):
+        ids = _TR.current_ids()  # None unless tracing is armed
         with self._lock:
             self._sum += value
             self._total += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._counts[i] += 1
+                    if ids is not None:
+                        self._exemplars[i] = (ids[0], value)
                     return
             self._counts[-1] += 1
+            if ids is not None:
+                self._exemplars[len(self.buckets)] = (ids[0], value)
 
     def quantile(self, q: float) -> float | None:
         """Estimated q-quantile (0 <= q <= 1) from the bucket counts —
@@ -214,22 +226,34 @@ class Histogram:
             out[key] = round(v, 6) if v is not None else None
         return out
 
-    def expose(self) -> str:
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: ``# {trace_id="…"} value`` appended to
+        a _bucket sample — the p99 bucket links to its forensic trace."""
+        if ex is None:
+            return ""
+        trace_id, value = ex
+        return f' # {{trace_id="{trace_id}"}} {value:g}'
+
+    def expose(self, exemplars: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
         base = _fmt_labels(self.labels)
         with self._lock:
+            exs = dict(self._exemplars) if exemplars else {}
             cum = 0
-            for b, c in zip(self.buckets, self._counts):
+            for i, (b, c) in enumerate(zip(self.buckets, self._counts)):
                 cum += c
                 lines.append(
                     f"{self.name}_bucket"
                     f"{_fmt_labels({**self.labels, 'le': f'{b:g}'})} {cum}"
+                    f"{self._exemplar_suffix(exs.get(i))}"
                 )
             cum += self._counts[-1]
             lines.append(
                 f"{self.name}_bucket"
                 f"{_fmt_labels({**self.labels, 'le': '+Inf'})} {cum}"
+                f"{self._exemplar_suffix(exs.get(len(self.buckets)))}"
             )
             lines.append(f"{self.name}_sum{base} {self._sum:g}")
             lines.append(f"{self.name}_count{base} {self._total}")
@@ -261,10 +285,12 @@ class Registry:
                 self._metrics[name] = m
             return m
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = False) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
-        lines = [m.expose() for m in metrics]
+        lines = [m.expose(exemplars=exemplars)
+                 if isinstance(m, Histogram) else m.expose()
+                 for m in metrics]
         lines.append(self._device_counters())
         lines.append(self._resilience_counters())
         lines.append(self._sched_counters())
@@ -281,7 +307,22 @@ class Registry:
             lines.append(prof)
         lines.append(self._aot_counters())
         lines.append(self._snapshot_counters())
+        obs = self._obs_counters(exemplars)
+        if obs:
+            lines.append(obs)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _obs_counters(exemplars: bool = False) -> str:
+        """Round-forensics families (obs module singletons) — only
+        when the obs package was ever imported (it always is on a full
+        node via the chain insert path; pure-metrics tests stay lean)."""
+        import sys
+
+        mod = sys.modules.get("harmony_tpu.obs")
+        if mod is None:
+            return ""
+        return mod.expose_metrics(exemplars=exemplars)
 
     @staticmethod
     def _process_gauges() -> str:
@@ -535,7 +576,12 @@ class MetricsServer:
                 status = 200
                 try:
                     if path == "/metrics":
-                        data = outer_registry.expose().encode()
+                        # ?exemplars=1 opts into the OpenMetrics
+                        # trace-id exemplar suffix; the default stays
+                        # plain Prometheus 0.0.4 text
+                        data = outer_registry.expose(
+                            exemplars=params.get("exemplars") == "1"
+                        ).encode()
                         ctype = "text/plain; version=0.0.4"
                     elif path == "/healthz":
                         # per-subsystem watchdog verdicts; 503 when any

@@ -82,6 +82,11 @@ class Node:
         self.roster = roster
         self.worker = Worker(self.chain, self.pool)
         self.host = registry.host
+        # node identity stamped onto every span this node creates —
+        # the in-process localnet shares ONE trace store, so without
+        # this the merged trace cannot tell leader from validators
+        self._node_tag = (getattr(self.host, "name", "")
+                          or f"shard{self.chain.shard_id}")
         self.topic = consensus_topic(network, self.chain.shard_id)
         self.sender = MessageSender(self.host, [self.topic])
         self._queue: queue.Queue = queue.Queue()
@@ -413,13 +418,15 @@ class Node:
         its context, so one round = one trace across all components."""
         if not self.is_leader or self._proposed:
             return None
-        if self._round_span is None:
-            self._round_span = trace.start(
-                "consensus.round", component="consensus",
-                block=self.block_num, view=self.view_id, role="leader",
-            )
-        with trace.use(self._round_span):
-            return self._propose_and_announce()
+        with trace.node_scope(self._node_tag):
+            if self._round_span is None:
+                self._round_span = trace.start(
+                    "consensus.round", component="consensus",
+                    block=self.block_num, view=self.view_id,
+                    role="leader",
+                )
+            with trace.use(self._round_span):
+                return self._propose_and_announce()
 
     def _propose_and_announce(self):
         if self._reproposal is not None:
@@ -558,15 +565,19 @@ class Node:
         number of messages processed."""
         self._finish_sync_if_done()
         n = 0
-        while not self._stop.is_set():
-            try:
-                payload = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self._handle(payload)
-            n += 1
-            if max_msgs and n >= max_msgs:
-                break
+        # node_scope: resumed per-message spans (consensus.<msgtype>,
+        # chain.finalize, the verifies they enqueue) carry THIS node's
+        # identity even when one pump thread drives many nodes
+        with trace.node_scope(self._node_tag):
+            while not self._stop.is_set():
+                try:
+                    payload = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._handle(payload)
+                n += 1
+                if max_msgs and n >= max_msgs:
+                    break
         return n
 
     def _handle(self, payload: bytes):
@@ -1517,6 +1528,7 @@ class Node:
         )
 
         def loop():
+            trace.bind_node(self._node_tag)  # span node attribution
             while not self._stop.is_set():
                 try:
                     hb.beat()

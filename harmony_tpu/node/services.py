@@ -29,6 +29,7 @@ class ServiceType(IntEnum):
     ROSETTA = 9    # this framework's ids; the reference serves rosetta
     WEBSOCKET = 10  # and WS from its RPC stack, not service slots
     MAINTENANCE = 11  # resource governor sampler + health watchdog
+    SPAN_SINK = 12  # durable span export (obs.SpanSink JSONL writer)
 
 
 class Service:

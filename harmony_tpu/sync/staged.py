@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 from ..log import get_logger
 from ..metrics import Counter as _MetricCounter
+from ..obs.replay import stage as replay_stage
 from ..resilience import Deadline
 
 BATCH = 64  # blocks per fetch/verify window
@@ -186,24 +187,27 @@ class Downloader:
     def _fetch_window(self, start: int, count: int, want_hashes: list):
         """Try peers in order until one serves blocks matching the
         agreed hashes."""
-        for c in self._peers():
-            try:
-                items = self._call(
-                    c, c.get_blocks_by_number, start, count,
-                    deadline=self._deadline(),
+        # the whole window fetch — peer round-trip, body decode, hash
+        # re-check — is the wire_decode stage of the replay burn-down
+        with replay_stage("wire_decode", start=start, count=count):
+            for c in self._peers():
+                try:
+                    items = self._call(
+                        c, c.get_blocks_by_number, start, count,
+                        deadline=self._deadline(),
+                    )
+                except (ConnectionError, OSError) as e:
+                    self._exclude(c, "bodies", e)
+                    continue
+                if not items:
+                    continue
+                ok = all(
+                    blk.hash() == want
+                    for (blk, _), want in zip(items, want_hashes)
                 )
-            except (ConnectionError, OSError) as e:
-                self._exclude(c, "bodies", e)
-                continue
-            if not items:
-                continue
-            ok = all(
-                blk.hash() == want
-                for (blk, _), want in zip(items, want_hashes)
-            )
-            if ok:
-                return items
-        return []
+                if ok:
+                    return items
+            return []
 
     # -- stages: fast (state) sync -----------------------------------------
 

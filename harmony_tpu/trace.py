@@ -78,6 +78,19 @@ _FLAG_SAMPLED = 0x01
 _current: ContextVar["Span | None"] = ContextVar("harmony_tpu_trace",
                                                  default=None)
 
+# -- node attribution: every span carries node= so traces merged across
+# the in-process localnet (one shared store) or across real processes
+# (JSONL sink files) remain attributable per node.  Resolution order:
+# thread/context binding (pump threads of an in-process localnet), then
+# the process-wide default (one real node per process, set by cli.py).
+_node_default: str | None = None
+_node_ctx: ContextVar["str | None"] = ContextVar("harmony_tpu_trace_node",
+                                                 default=None)
+
+# Export hook (obs.SpanSink): called with each finished Span.  A plain
+# module global read on the finish path — None when no sink is armed.
+_export_hook = None
+
 _finished: deque = deque(maxlen=_STORE_CAP)
 _events: deque = deque(maxlen=_EVENT_CAP)
 _active: dict[str, "Span"] = {}  # span_id -> open span (dump visibility)
@@ -136,12 +149,71 @@ def round_slo_s() -> float | None:
     return _round_slo_s
 
 
+def set_node(name: str | None) -> None:
+    """Process-wide node identity stamped onto every span (``node=``
+    attr).  One real node per process: cli.py sets this once at boot."""
+    global _node_default
+    _node_default = name
+
+
+def bind_node(name: str | None) -> None:
+    """Bind a node identity to the CURRENT thread/context — the
+    in-process localnet runs several nodes in one process, so each
+    consensus pump binds its own name at thread start.  Overrides the
+    process default for spans created under this context."""
+    _node_ctx.set(name)
+
+
+class _NodeScope:
+    """Context manager scoping a node binding (pump-driven tests run
+    many nodes on ONE thread, so the binding must nest and restore)."""
+
+    __slots__ = ("_name", "_token")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._token = None
+
+    def __enter__(self):
+        self._token = _node_ctx.set(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        _node_ctx.reset(self._token)
+        return False
+
+
+def node_scope(name: str):
+    """``with trace.node_scope("shard0-a"):`` — spans created inside
+    carry ``node=name``.  Disabled cost: one comparison."""
+    if not _enabled:
+        return _NOOP
+    return _NodeScope(name)
+
+
+def current_node() -> str | None:
+    """The node identity spans would be stamped with right now."""
+    node = _node_ctx.get()
+    return node if node is not None else _node_default
+
+
+def set_export_hook(hook) -> None:
+    """Install (or clear, with None) the finished-span export hook.
+    Called synchronously from ``finish`` — implementations must be
+    O(queue append) and never raise (obs.SpanSink qualifies)."""
+    global _export_hook
+    _export_hook = hook
+
+
 def reset() -> None:
     """Disarm and drop every buffer (test teardown).  Dump FILES are
     left on disk — they are the evidence a failed test points at."""
     global _enabled, _sample_rate, _round_slo_s, _dump_dir
     global _dump_cooldown_s, _dump_total, _dump_budget_bytes, _dump_bytes
+    global _node_default, _export_hook
     _enabled = False
+    _node_default = None
+    _export_hook = None
     _sample_rate = 1.0
     _round_slo_s = None
     _dump_dir = None
@@ -186,6 +258,12 @@ class Span:
         self.t0 = time.monotonic()
         self.dur_s: float | None = None
         self.attrs = attrs
+        if "node" not in attrs:
+            node = _node_ctx.get()
+            if node is None:
+                node = _node_default
+            if node is not None:
+                attrs["node"] = node
         t = threading.current_thread()
         self.tid = t.ident or 0
         self.pid = _PID
@@ -310,6 +388,12 @@ def finish(span) -> float | None:
     span.dur_s = time.monotonic() - span.t0
     _active.pop(span.span_id, None)
     _finished.append(span)
+    hook = _export_hook
+    if hook is not None:
+        try:
+            hook(span)
+        except Exception:  # noqa: BLE001 — a broken sink must never
+            pass  # break the span lifecycle of the path that traced
     return span.dur_s
 
 

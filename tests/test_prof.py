@@ -23,7 +23,13 @@ from obs_smoke import validate_prometheus  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
-def _clean():
+def _clean(monkeypatch):
+    # the first-dispatch assertions below need a fresh per-program
+    # seen-set: earlier suite files (the chaostest scenarios) dispatch
+    # the same bucket-8 programs and would otherwise mark them used
+    from harmony_tpu import device as DV
+
+    monkeypatch.setattr(DV, "_SEEN_PROGRAMS", set())
     prof.reset()
     trace.reset()
     yield

@@ -4,6 +4,7 @@ mutated / truncated / length-inflated inputs must raise TYPED errors
 allocate unbounded buffers.  Every case derives from random.Random(seed)
 so a failure reproduces exactly."""
 
+import json
 import random
 import socket
 import struct
@@ -402,6 +403,142 @@ def test_paginate_state_count_inflation_rejected_fast():
     with pytest.raises(SnapshotError, match="implausible"):
         paginate_state(bytes(blob))
     assert time.monotonic() - t0 < 0.1
+
+
+# -- ISSUE 19: trace context + span-sink reader (forensics inputs) -----------
+#
+# Two taint surfaces the forensics arc adds: the 26-byte trace_ctx a
+# consensus message carries (transport metadata a hostile peer fully
+# controls), and the JSONL sink files round_forensics.py merges (they
+# travel from other machines and are truncated by the very crash under
+# investigation).  Neither may crash the node or pollute the span
+# store.
+
+
+def test_fuzz_trace_ctx_never_crashes_or_pollutes_store():
+    from harmony_tpu import trace
+
+    trace.reset()
+    trace.configure(enabled=True)
+    try:
+        with trace.span("legit", component="consensus") as sp:
+            good = trace.traceparent()
+        assert trace.parse_traceparent(good) == (sp.trace_id, sp.span_id)
+        before = len(trace.spans())
+        rng = random.Random(SEED)
+        well_formed = 0
+        for mutant in _mutations(rng, good):
+            # parse is total: bytes in, (ids | None) out, NEVER a raise
+            parsed = trace.parse_traceparent(mutant)
+            if parsed is None:
+                # malformed context: resume is the shared no-op and
+                # plants NOTHING in the store
+                n0 = len(trace.spans())
+                with trace.resume(mutant, "consensus.prepare"):
+                    pass
+                assert len(trace.spans()) == n0
+            else:
+                # a flipped-but-well-formed context is indistinguishable
+                # from a legit remote trace: it may resume, but only
+                # with structurally valid hex ids
+                tid, sid = parsed
+                int(tid, 16), int(sid, 16)
+                assert len(tid) == 32 and len(sid) == 16
+                well_formed += 1
+                with trace.resume(mutant, "consensus.prepare"):
+                    pass
+        # the store grew by exactly the well-formed resumes — garbled
+        # contexts contributed zero entries
+        assert len(trace.spans()) == before + well_formed
+    finally:
+        trace.reset()
+
+
+def test_fuzz_consensus_trace_ctx_through_the_codec():
+    """The full path a hostile peer reaches: mutated trace_ctx bytes
+    ride a VALID message through decode, then the receiver resumes on
+    whatever arrived.  Typed rejection or clean resume — no third
+    outcome, and the store stays unpolluted."""
+    from harmony_tpu import trace
+
+    keys = PrivateKeys.from_keys([B.PrivateKey.generate(b"\x31")])
+    trace.reset()
+    trace.configure(enabled=True)
+    try:
+        rng = random.Random(SEED)
+        t0 = time.monotonic()
+        for junk in (b"", b"\x00", rng.randbytes(25), rng.randbytes(26),
+                     rng.randbytes(27), b"\xff" * 26, b"\x00" * 26,
+                     rng.randbytes(200)):
+            msg = sign_message(FBFTMessage(
+                msg_type=MsgType.PREPARE, view_id=1, block_num=2,
+                block_hash=bytes(32),
+                sender_pubkeys=[keys[0].pub.bytes],
+                payload=b"\x05" * 97, trace_ctx=junk,
+            ), keys)
+            wired = decode_message(encode_message(msg))
+            assert wired.trace_ctx == junk  # transport metadata survives
+            with trace.resume(wired.trace_ctx, "consensus.prepare"):
+                pass
+        # resumes on junk recorded nothing; resumes on a valid-length
+        # random context recorded AT MOST orphan spans with well-formed
+        # ids — never an exception, never a malformed store entry
+        for s in trace.spans():
+            assert len(s.trace_id) == 32 and len(s.span_id) == 16
+        assert time.monotonic() - t0 < 20.0
+    finally:
+        trace.reset()
+
+
+def test_fuzz_span_sink_reader(tmp_path):
+    """read_spans over mutated sink files: mutants of a valid JSONL
+    file (flips, truncations, splices, inflations) must never raise
+    and never emit a record missing the span schema — the reader
+    budget-checks each line before json.loads allocates on it."""
+    from harmony_tpu.obs import read_spans
+
+    base_records = [
+        {"trace_id": "ab" * 16, "span_id": f"{i:02x}" * 8,
+         "name": "consensus.round", "ts": 100.0 + i, "dur_s": 0.5,
+         "pid": 1, "tid": 2, "attrs": {"node": f"node{i}", "block": i}}
+        for i in range(4)
+    ]
+    base = ("\n".join(
+        json.dumps(r) for r in base_records
+    ) + "\n").encode()
+    p = tmp_path / "spans_fuzz.jsonl"
+    rng = random.Random(SEED)
+    t0 = time.monotonic()
+    for mutant in _mutations(rng, base):
+        p.write_bytes(mutant)
+        for rec in read_spans(str(p)):  # must not raise
+            # schema holds on every surviving record
+            assert isinstance(rec["trace_id"], str)
+            assert isinstance(rec["span_id"], str)
+            assert isinstance(rec["name"], str)
+            assert isinstance(rec["ts"], (int, float))
+    took = time.monotonic() - t0
+    assert took < 20.0, f"sink-reader fuzz took {took:.1f}s"
+
+
+def test_span_sink_reader_oversize_line_budget(tmp_path):
+    """A multi-megabyte single line costs bounded chunk reads, never a
+    whole-line buffer: the 64 KiB record budget is enforced BEFORE
+    allocation, and parsing stays fast."""
+    from harmony_tpu.obs import read_spans
+
+    p = tmp_path / "spans_big.jsonl"
+    good = json.dumps(
+        {"trace_id": "cd" * 16, "span_id": "ef" * 8, "name": "x",
+         "ts": 1.0, "dur_s": 0.1, "pid": 1, "tid": 1, "attrs": {}}
+    )
+    with open(p, "w") as f:
+        f.write('{"pad": "' + "y" * (8 * 1024 * 1024) + '"}\n')
+        f.write(good + "\n")
+    t0 = time.monotonic()
+    out = read_spans(str(p))
+    assert time.monotonic() - t0 < 2.0
+    assert len(out) == 1 and out[0]["span_id"] == "ef" * 8
 
 
 def test_stored_batch_count_inflation_rejected_fast():

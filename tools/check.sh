@@ -122,6 +122,15 @@
 #      composed invariant set; rehearsal metrics
 #      (snapshot_bootstrap_seconds, join_catchup_seconds, ...) land
 #      as an ephemeral BENCH round gated by bench_ledger --check.
+#  13. round forensics (ISSUE 19) — the obs unit tier (RoundTimeline
+#      phase attribution >= 95% on a pump-driven round, span-sink
+#      rotation/heartbeat/reader budgets, clock-skew alignment,
+#      histogram exemplars), then tools/round_forensics.py --check
+#      over a fresh in-process wan_committee --quick run: >= 95% of
+#      committed-round wall time must attribute to named phases and
+#      the report must name the dominating phase; bench_ledger
+#      --check @ 0.8 covers the committed BENCH_r12.json
+#      (round_phase_* / replay_stage_* as source: measured).
 #
 # Usage: tools/check.sh            (from anywhere; cd's to the repo)
 set -euo pipefail
@@ -266,5 +275,14 @@ JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --bench-out "$REHEARSAL_ROUND" --bench-round 994 > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json "$REHEARSAL_ROUND" > /dev/null
+
+echo "== round forensics: phase attribution + replay burn-down =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_obs.py
+JAX_PLATFORMS=cpu python tools/round_forensics.py \
+  --scenario wan_committee --quick --check > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json > /dev/null
 
 echo "check.sh: OK"

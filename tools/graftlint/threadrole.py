@@ -88,6 +88,7 @@ ROLE_POLICY = {
     "sidecar.reader":   {"latency_critical": False, "heartbeat": True},
     "governor.sampler": {"latency_critical": False, "heartbeat": True},
     "netem.scheduler":  {"latency_critical": False, "heartbeat": True},
+    "obs.sink":         {"latency_critical": False, "heartbeat": True},
     "watchdog":         {"latency_critical": False, "heartbeat": False},
     # the union label for the general serving plane (rpc, metrics,
     # explorer, discovery, accept loops): long-lived but off the

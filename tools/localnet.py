@@ -112,11 +112,19 @@ class Net:
             "--phase-timeout", str(self.args.phase_timeout),
             "--skip-ntp-check",
         ]
-        if self.args.trace:
+        if self.args.trace or self.args.trace_dir:
             # round tracing on every node: each serves its own
             # /debug/trace; one round's spans share one trace_id
             # across processes (correlate by trace_id in Perfetto)
             cmd += ["--trace"]
+        if self.args.trace_dir:
+            # durable span export: every node writes rotating JSONL
+            # into the shared dir (spans_<node>.jsonl — the node tag
+            # disambiguates); feed the files to tools/round_forensics.py
+            # for cross-node phase attribution
+            trace_dir = pathlib.Path(self.args.trace_dir)
+            trace_dir.mkdir(parents=True, exist_ok=True)
+            cmd += ["--span-sink-dir", str(trace_dir)]
         if self.args.device_path:
             # VERDICT r4 #3: live consensus THROUGH the device path —
             # device.py forced on, every quorum check routed through
@@ -264,6 +272,11 @@ def main(argv=None):
     p.add_argument("--trace", action="store_true",
                    help="arm round tracing + flight recorder on every "
                         "node (GET /debug/trace on each metrics port)")
+    p.add_argument("--trace-dir", default=None,
+                   help="durable span export: arm tracing (implies "
+                        "--trace) and have every node write rotating "
+                        "JSONL span files into this directory; analyze "
+                        "them with tools/round_forensics.py")
     args = p.parse_args(argv)
     if args.cross_shard and args.shards < 2:
         args.shards = 2

@@ -44,13 +44,20 @@ _TYPE_RE = re.compile(
     rf"^# TYPE {_METRIC} (counter|gauge|histogram|summary|untyped)$"
 )
 _LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}'
-_SAMPLE_RE = re.compile(
-    rf"^{_METRIC}({_LABELS})? [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[0-9]+)$"
+_NUMBER = r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[0-9]+)"
+_SAMPLE_RE = re.compile(rf"^{_METRIC}({_LABELS})? {_NUMBER}$")
+# OpenMetrics exemplar suffix (``?exemplars=1`` scrape): only _bucket
+# samples may carry ``# {trace_id="…"} value``
+_SAMPLE_EX_RE = re.compile(
+    rf"^{_METRIC}_bucket({_LABELS})? {_NUMBER}"
+    rf'( # \{{trace_id="[0-9a-f]+"\}} {_NUMBER})?$'
 )
 
 
-def validate_prometheus(text: str) -> list:
-    """Offending lines (empty = valid exposition)."""
+def validate_prometheus(text: str, exemplars: bool = False) -> list:
+    """Offending lines (empty = valid exposition).  ``exemplars``
+    additionally admits the OpenMetrics trace-id suffix on _bucket
+    sample lines — the grammar of a ``/metrics?exemplars=1`` scrape."""
     bad = []
     for line in text.splitlines():
         if not line:
@@ -62,7 +69,9 @@ def validate_prometheus(text: str) -> list:
         elif line.startswith("#"):
             ok = True  # free-form comment
         else:
-            ok = _SAMPLE_RE.match(line)
+            ok = _SAMPLE_RE.match(line) or (
+                exemplars and _SAMPLE_EX_RE.match(line)
+            )
         if not ok:
             bad.append(line)
     return bad
@@ -187,6 +196,7 @@ def main() -> int:
     srv = MetricsServer(metrics_registry, port=0).start()
     try:
         metrics_text = scrape(srv.port, "/metrics").decode()
+        exemplar_text = scrape(srv.port, "/metrics?exemplars=1").decode()
         trace_doc = json.loads(
             scrape(srv.port, f"/debug/trace?trace_id={trace_id}")
         )
@@ -202,12 +212,27 @@ def main() -> int:
     for family in ("harmony_device_checks_total",
                    "harmony_device_dispatch_seconds",
                    "harmony_consensus_round_seconds",
-                   "harmony_device_transfer_bytes_total"):
+                   "harmony_device_transfer_bytes_total",
+                   "harmony_replay_stage_seconds",
+                   "harmony_round_phase_seconds"):
         if family not in metrics_text:
             print(f"obs_smoke: /metrics missing family {family}")
             return 1
     print(f"obs_smoke: /metrics OK "
           f"({len(metrics_text.splitlines())} lines, grammar-valid)")
+
+    bad = validate_prometheus(exemplar_text, exemplars=True)
+    if bad:
+        print("obs_smoke: INVALID exemplar exposition lines:")
+        for line in bad[:20]:
+            print(f"  {line!r}")
+        return 1
+    if ' # {trace_id="' not in exemplar_text:
+        print("obs_smoke: ?exemplars=1 carried no trace-id exemplar "
+              "despite a traced round")
+        return 1
+    print("obs_smoke: /metrics?exemplars=1 OK (grammar-valid, "
+          "trace-linked)")
 
     bad = validate_trace_events(trace_doc)
     if bad:
