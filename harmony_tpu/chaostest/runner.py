@@ -282,6 +282,8 @@ def _build(scenario: Scenario, registry, built: list | None = None
         reg.set("metrics", registry)
         if top.shards > 1:
             reg.set("shard_count", top.shards)
+        if top.aggregation != "direct":
+            reg.set("aggregation", top.aggregation)
         handle.sync_server = SyncServer(
             handle.chain, listen_port=handle.sync_port
         )
@@ -1530,6 +1532,43 @@ def run(scenario: Scenario, registry=None) -> ScenarioResult:
         )
         metrics["join_lag_blocks"] = _m(
             env.data.get("join_lag", 0), "blocks",
+        )
+    # leader-inbound accounting (ISSUE 20): the leader ingests two
+    # kinds of vote-bearing traffic — leader-addressed BALLOTS (the
+    # shared consensus topic delivers each to every host once, so the
+    # busiest host's ballot count is the per-leader count) and
+    # aggregation contributions on the leader SLOT's directed topic
+    # (the ladder's hottest target).  Per-HOST aggregate totals would
+    # bundle the ~50-slots-per-localnet-node intermediate rungs a
+    # real committee spreads over one machine per slot, so the
+    # per-slot split is read instead — THE number the Handel overlay
+    # shrinks from O(N) toward O(log N)
+    _hosts = [h.host for h in env.handles if h.host is not None]
+    inbound_votes = max(
+        (
+            sum(
+                v
+                for (_phase, kind), v in getattr(
+                    h, "inbound_votes", {}
+                ).items()
+                if kind == "ballot"
+            )
+            for h in _hosts
+        ),
+        default=0,
+    ) + max(
+        (
+            c
+            for h in _hosts
+            for c in getattr(h, "inbound_agg_slots", {}).values()
+        ),
+        default=0,
+    )
+    if env.round_durs:
+        metrics["leader_inbound_msgs_per_round"] = _m(
+            round(inbound_votes / len(env.round_durs), 3), "messages",
+            rounds=len(env.round_durs), total=inbound_votes,
+            derived_from="host_inbound_votes",
         )
     # scenario-specific measured extras (the byzantine scenarios stash
     # their evidence-pipeline numbers here from custom invariants)

@@ -92,6 +92,11 @@ class Topology:
     # only viable shape for a large-state scenario, where a
     # pure-python secure-trie seal would take minutes per block
     flat_root: bool = False
+    # vote transport (ISSUE 20): "handel" routes prepare/commit votes
+    # through the multi-level aggregation overlay
+    # (consensus.aggregation); the "direct" default keeps every
+    # pre-existing scenario's wire traffic byte-identical
+    aggregation: str = "direct"
 
 
 @dataclass(frozen=True)

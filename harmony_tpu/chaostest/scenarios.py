@@ -968,6 +968,242 @@ def wan_committee(quick: bool = False) -> Scenario:
     )
 
 
+def _leader_inbound_per_round(env):
+    """The rotating leaders' vote ingest per committed round.
+
+    The leader receives exactly two kinds of vote-bearing traffic:
+    direct/fallback BALLOTS (leader-addressed; they ride the shared
+    consensus topic, so the busiest host's ballot count IS the
+    per-leader count, every host hears each one once) and
+    AGGREGATION contributions landing on the leader slot's directed
+    topic — the ladder's convergence point, the hottest slot in the
+    overlay.  A localnet host multiplexes ~50 committee slots, so
+    per-HOST aggregate totals bundle intermediate-rung traffic a
+    real committee spreads over one machine per slot; the per-slot
+    split (``Host.inbound_agg_slots``) reads off the leader slot's
+    actual ingest instead."""
+    hosts = [h.host for h in env.handles if h.host is not None]
+    ballots = max(
+        (
+            sum(
+                v
+                for (_phase, kind), v in getattr(
+                    h, "inbound_votes", {}
+                ).items()
+                if kind == "ballot"
+            )
+            for h in hosts
+        ),
+        default=0,
+    )
+    agg_hot = max(
+        (
+            c
+            for h in hosts
+            for c in getattr(h, "inbound_agg_slots", {}).values()
+        ),
+        default=0,
+    )
+    rounds = len(env.round_durs)
+    return ballots + agg_hot, rounds
+
+
+def _wan200_overlay_quorum(env):
+    """ISSUE 20 acceptance: the live committee carries >= 200 slots
+    (the reference's mainnet shard shape), quorum was assembled
+    THROUGH the aggregation overlay (contributions merged, zero
+    forged partials accepted), the WAN matrix actually conditioned
+    traffic — and the rotating leaders' inbound vote traffic averaged
+    <= committee_size/4 messages per committed round, the O(log N)
+    assembly bound the overlay exists to buy (direct assembly would
+    ingest ~N ballots per round)."""
+    chain = env.honest(0)[0].chain
+    epoch = chain.epoch_of(chain.head_number)
+    slots = len(chain.committee_for_epoch(epoch))
+    if slots < 200:
+        return False, f"live committee carries {slots} slots (< 200)"
+    stats = [
+        h.node.aggregation_stats()
+        for h in env.honest(0) if h.node is not None
+    ]
+    merged = sum(s["merged"] for s in stats)
+    emissions = sum(s["emissions"] for s in stats)
+    forged = sum(s["forged"] for s in stats)
+    if merged < 1 or emissions < 1:
+        return False, (
+            f"overlay never engaged (merged={merged}, "
+            f"emissions={emissions}) — votes took the direct path"
+        )
+    if forged:
+        return False, f"{forged} forged partial(s) survived verification"
+    tot = env.net.netem.totals()
+    if tot.get("delayed", 0) < 50:
+        return False, (
+            f"only {tot.get('delayed', 0)} messages rode the WAN "
+            "matrix — the conditioner never engaged"
+        )
+    inbound, rounds = _leader_inbound_per_round(env)
+    if rounds < 1:
+        return False, "no committed rounds were measured"
+    per_round = inbound / rounds
+    bound = slots / 4.0
+    if per_round > bound:
+        return False, (
+            f"leader inbound {per_round:.1f} vote msgs/round exceeds "
+            f"{bound:.0f} (= committee_size/4) — the overlay did not "
+            "compress quorum assembly"
+        )
+    env.data.setdefault("extra_metrics", {}).update({
+        "wan200_committee_slots": _m(slots, "slots"),
+        "wan200_overlay_merged": _m(merged, "contributions"),
+        "wan200_overlay_fallbacks": _m(
+            sum(s["fallbacks"] for s in stats), "ballots"
+        ),
+        "wan200_leader_inbound_bound": _m(round(bound, 1), "messages"),
+    })
+    return True, ""
+
+
+def wan_committee_200(quick: bool = False) -> Scenario:
+    """The gating ISSUE 20 scenario: a LIVE 200-slot committee — the
+    reference's mainnet shard shape, 50-key operators on a 4-node
+    localnet — committing under the WAN latency matrix with
+    prepare/commit votes routed through the Handel-style aggregation
+    overlay.  Liveness, zero consensus-lane sheds and the round p99
+    bound must hold while the rotating leaders ingest at most
+    committee_size/4 vote-bearing messages per committed round
+    (``leader_inbound_msgs_per_round`` lands in the BENCH ledger as
+    the overlay yardstick; ``wan_committee`` seed 71 is the 64-slot
+    direct-path baseline)."""
+    return Scenario(
+        name="wan_committee_200",
+        seed=79,
+        # a 200-slot round costs ~5 s announce-to-vote per node on a
+        # shared box (block verify + 50-key signing) before the WAN
+        # RTTs stack on top: the phase timeout must clear a full
+        # assemble-twice (prepare + commit) arc or every view wedges
+        # into a VC storm before quorum can form
+        topology=Topology(
+            nodes=4, committee_size=200, block_time_s=1.0,
+            phase_timeout_s=20.0 if quick else 25.0,
+            aggregation="handel",
+        ),
+        traffic=Traffic(
+            # light tx pressure only: this scenario measures VOTE
+            # compression, and on a shared box heavy adversarial
+            # traffic just starves the 200-slot crypto of CPU
+            plain_rate=10.0 if quick else 60.0,
+            pop_rate=1.0, replay_workers=1,
+            flood_duration_s=2.0 if quick else 6.0,
+        ),
+        phases=(
+            Phase(
+                "wan-matrix", at_s=0.0, duration_s=None,
+                links=("*->* rtt=50..150ms jitter=10ms loss=0.5%",),
+            ),
+        ),
+        # p99 is 200-slot-shaped: every quorum proof aggregates 200
+        # keys over conditioned links — the SHARP assertions are the
+        # overlay custom (inbound compression + zero forged) plus
+        # liveness and zero consensus sheds
+        invariants=Invariants(
+            min_blocks=3 if quick else 6,
+            round_p99_s=90.0,
+            custom=(("wan200_overlay_quorum", _wan200_overlay_quorum),),
+        ),
+        window_s=260.0 if quick else 420.0,
+    )
+
+
+def _gray_overlay_survived(env):
+    """Gray aggregator: the overlay must have been exercised, and the
+    committee must have made progress THROUGH the degraded window —
+    either the ladder kept assembling despite the gray links, or the
+    stall fallback shipped direct ballots (the loss-safety escape
+    hatch), or a NEWVIEW routed around the gray leader.  A window
+    with none of those is the wedge a degraded aggregator could
+    newly introduce."""
+    ph = env.data.get("phase_heads", {}).get("gray-aggregator")
+    if ph is None:
+        return False, "the gray-aggregator phase never armed"
+    if ph[1] is None:
+        return False, "the gray-aggregator phase never healed"
+    stats = [
+        h.node.aggregation_stats()
+        for h in env.honest(0) if h.node is not None
+    ]
+    merged = sum(s["merged"] for s in stats)
+    fallbacks = sum(s["fallbacks"] for s in stats)
+    if merged < 1:
+        return False, "overlay never engaged (zero merged contributions)"
+    committed = ph[1] - ph[0]
+    adoptions = _adoptions(env)
+    if committed < 1 and fallbacks < 1 and adoptions < 1:
+        return False, (
+            "WEDGE: zero blocks, zero direct-ballot fallbacks and "
+            "zero NEWVIEW adoptions across the degraded window"
+        )
+    tot = env.net.netem.totals()
+    if tot.get("delayed", 0) < 10:
+        return False, (
+            f"only {tot.get('delayed', 0)} messages conditioned — the "
+            "gray links never engaged"
+        )
+    env.data.setdefault("extra_metrics", {}).update({
+        "gray_agg_window_blocks": _m(committed, "blocks"),
+        "gray_agg_fallbacks": _m(fallbacks, "ballots"),
+        "gray_agg_merged": _m(merged, "contributions"),
+    })
+    return True, ""
+
+
+def gray_aggregator(quick: bool = False) -> Scenario:
+    """The overlay's gray-failure variant (ISSUE 20 loss-safety): the
+    round leader — the ladder's FINAL aggregator, where every
+    last-rung contribution lands — degraded to 300 ms + jitter + 5 %
+    loss in both directions while votes ride the Handel overlay.
+    Rounds must keep committing (re-emission absorbs the loss), or
+    stalled phases must take the direct-to-leader fallback, or the
+    committee must view-change past the gray leader; never wedge,
+    never fork, zero consensus sheds."""
+    return Scenario(
+        name="gray_aggregator",
+        seed=83,
+        topology=Topology(
+            nodes=4, committee_size=16, block_time_s=0.25,
+            phase_timeout_s=2.5 if quick else 4.0,
+            aggregation="handel",
+        ),
+        traffic=Traffic(
+            plain_rate=100.0 if quick else 300.0,
+            replay_workers=1,
+            flood_duration_s=4.0 if quick else 8.0,
+        ),
+        phases=(
+            Phase(
+                "gray-aggregator", at_round=2,
+                duration_s=8.0 if quick else 16.0,
+                links=(
+                    {"src": "round_leader", "dst": "*",
+                     "delay_ms": 300.0, "jitter_ms": 80.0,
+                     "loss": 0.05},
+                    {"src": "*", "dst": "round_leader",
+                     "delay_ms": 300.0, "jitter_ms": 80.0,
+                     "loss": 0.05},
+                ),
+            ),
+        ),
+        # same gray-shaped p99 rationale as gray_leader: the SHARP
+        # assertions are overlay survival + liveness + no fork
+        invariants=Invariants(
+            min_blocks=5 if quick else 9,
+            round_p99_s=60.0,
+            custom=(("gray_overlay_survived", _gray_overlay_survived),),
+        ),
+        window_s=120.0 if quick else 240.0,
+    )
+
+
 # -- overload scenarios (ISSUE 14): past rated capacity ----------------------
 
 
@@ -1347,5 +1583,7 @@ SCENARIOS = {
     "asymmetric_partition": asymmetric_partition,
     "minority_partition_heal": minority_partition_heal,
     "wan_committee": wan_committee,
+    "wan_committee_200": wan_committee_200,
+    "gray_aggregator": gray_aggregator,
     "mainnet_rehearsal": mainnet_rehearsal,
 }

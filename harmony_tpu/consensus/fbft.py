@@ -215,6 +215,39 @@ class Leader(_Node):
             payload=self._quorum_proof(Phase.COMMIT, self.commit_sigs),
         ), self.keys)
 
+    def prepared_from_proof(self, block_hash: bytes, proof: bytes):
+        """PREPARED built from an externally-assembled quorum proof —
+        the aggregation overlay's path (consensus.aggregation): every
+        piece of the aggregate was pairing-verified before merging and
+        the caller checked quorum-by-mask, so the ballot store is
+        bypassed.  Same message shape ``try_prepared`` emits, same
+        announced-hash guard."""
+        if block_hash != self.current_block_hash:
+            return None
+        return sign_message(FBFTMessage(
+            msg_type=MsgType.PREPARED,
+            view_id=self.cfg.view_id,
+            block_num=self.cfg.block_num,
+            block_hash=block_hash,
+            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            payload=proof,
+            block=self.log.get_block(block_hash) or b"",
+        ), self.keys)
+
+    def committed_from_proof(self, block_hash: bytes, proof: bytes):
+        """COMMITTED from an overlay-assembled proof (see
+        :meth:`prepared_from_proof`)."""
+        if block_hash != self.current_block_hash:
+            return None
+        return sign_message(FBFTMessage(
+            msg_type=MsgType.COMMITTED,
+            view_id=self.cfg.view_id,
+            block_num=self.cfg.block_num,
+            block_hash=block_hash,
+            sender_pubkeys=[k.pub.bytes for k in self.keys],
+            payload=proof,
+        ), self.keys)
+
 
 class Validator(_Node):
     """Signs votes; verifies aggregate proofs (reference:

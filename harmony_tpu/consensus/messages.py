@@ -208,6 +208,88 @@ def encode_message(msg: FBFTMessage) -> bytes:
     return bytes(out)
 
 
+# -- aggregation overlay codec (consensus.aggregation) -----------------------
+
+# hard ceiling on the participation bitmap, in BYTES: a 16384-slot
+# committee — far above any mainnet shape, and small enough that the
+# bound itself can never be the allocation attack
+AGG_BITMAP_MAX = 2048
+_AGG_FIXED = 1 + 8 + 8 + 32 + 1 + 2 + SIG_BYTES + 2
+
+
+@dataclass
+class AggContribution:
+    """One partial multi-signature riding the aggregation overlay:
+    self-certifying (the aggregate sig IS the authenticity proof — a
+    forged one fails the pairing check against the bitmap's keys), so
+    there is no sender signature to carry or verify."""
+
+    phase: int          # aggregation.PHASE_PREPARE / PHASE_COMMIT
+    view_id: int
+    block_num: int
+    block_hash: bytes
+    level: int          # emitter's ladder level (observability)
+    bitmap: bytes       # participation mask, Mask bit order
+    sig: bytes          # 96B aggregate signature over the phase payload
+    sender_slot: int    # emitter's home slot (attribution only)
+
+
+def encode_aggregation(c: AggContribution) -> bytes:
+    """[phase u8][view u64le][block u64le][hash 32][level u8]
+    [bitmap u16le + bytes][sig 96B][sender_slot u16le]."""
+    if len(c.block_hash) != 32:
+        raise ValueError("block hash must be 32 bytes")
+    if len(c.sig) != SIG_BYTES:
+        raise ValueError("aggregate signature must be 96 bytes")
+    if not c.bitmap or len(c.bitmap) > AGG_BITMAP_MAX:
+        raise ValueError("bitmap length out of range")
+    out = bytearray()
+    out += bytes([c.phase])
+    out += c.view_id.to_bytes(8, "little")
+    out += c.block_num.to_bytes(8, "little")
+    out += c.block_hash
+    out += bytes([c.level])
+    out += len(c.bitmap).to_bytes(2, "little") + c.bitmap
+    out += c.sig
+    out += c.sender_slot.to_bytes(2, "little")
+    return bytes(out)
+
+
+def decode_aggregation(data: bytes) -> AggContribution:
+    """Bounded decode (GL13): the ONE variable-length field's claimed
+    size is budget-checked against both the hard ceiling and the
+    actual bytes present BEFORE any slice, and the total length must
+    match exactly — a length-inflated or truncated wire raises a typed
+    ValueError without allocating anything proportional to the claim."""
+    view = memoryview(data)
+    if len(view) < _AGG_FIXED + 1:
+        raise ValueError("aggregation message too short")
+    phase = view[0]
+    if phase not in (1, 2):
+        raise ValueError("bad aggregation phase")
+    bitmap_len = int.from_bytes(view[50:52], "little")
+    if bitmap_len == 0 or bitmap_len > AGG_BITMAP_MAX:
+        raise ValueError("absurd bitmap length")
+    if len(view) != _AGG_FIXED + bitmap_len:
+        raise ValueError(
+            f"aggregation length {len(view)} != expected "
+            f"{_AGG_FIXED + bitmap_len}"
+        )
+    off = 52 + bitmap_len
+    return AggContribution(
+        phase=phase,
+        view_id=int.from_bytes(view[1:9], "little"),
+        block_num=int.from_bytes(view[9:17], "little"),
+        block_hash=bytes(view[17:49]),
+        level=view[49],
+        bitmap=bytes(view[52:off]),
+        sig=bytes(view[off:off + SIG_BYTES]),
+        sender_slot=int.from_bytes(
+            view[off + SIG_BYTES:off + SIG_BYTES + 2], "little"
+        ),
+    )
+
+
 def decode_message(data: bytes) -> FBFTMessage:
     """Bounded decode: every length prefix is checked against the
     remaining bytes BEFORE its slice, so a length-inflated wire raises

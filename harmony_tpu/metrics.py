@@ -406,6 +406,7 @@ class Registry:
             out.append(
                 f'harmony_p2p_peer_score{{host="{host_name}"}} {score:g}'
             )
+        out.append(PH.INBOUND_VOTES.expose())
         return "\n".join(out)
 
     @staticmethod

@@ -37,6 +37,10 @@ class MessageCategory(IntEnum):
 # NODE-category message types (reference: api/proto/node — the node
 # service's own wire types ride the same envelope)
 NODE_MSG_SLASH = 0x10  # body: one encoded slash.Record
+NODE_MSG_AGG = 0x11    # body: one encoded aggregation contribution
+#                        (consensus.messages.decode_aggregation) —
+#                        rides the NODE category so the CONSENSUS
+#                        role-filter/bitmap-sanity path never applies
 
 
 def pack_envelope(category: MessageCategory, msg_type: int, payload: bytes) -> bytes:

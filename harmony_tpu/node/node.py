@@ -24,11 +24,15 @@ import threading
 import time
 
 from .. import health, trace
+from ..consensus import aggregation as AGG
 from ..consensus.fbft import Leader, RoundConfig, Validator
 from ..consensus.messages import (
+    AggContribution,
     FBFTMessage,
     MsgType,
+    decode_aggregation,
     decode_message,
+    encode_aggregation,
     encode_message,
     sign_message,
 )
@@ -40,6 +44,7 @@ from ..consensus.safety import (
     SafetyStore,
 )
 from ..consensus.sender import MessageSender
+from ..consensus.signature import prepare_payload
 from ..consensus.view_change import (
     ViewChangeCollector,
     construct_viewchange,
@@ -53,10 +58,11 @@ from ..core import rawdb
 from ..core.blockchain import ChainError
 from ..log import get_logger
 from ..multibls import PrivateKeys
-from ..p2p import consensus_topic, slash_topic
+from ..p2p import aggregation_topic, consensus_topic, slash_topic
 from ..p2p.host import ACCEPT, IGNORE, REJECT
 from ..staking import slash as SL
 from .ingress import (
+    NODE_MSG_AGG,
     NODE_MSG_SLASH,
     VIEW_ID_WINDOW,
     IngressContext,
@@ -197,6 +203,22 @@ class Node:
                 self._cx_topic,
                 lambda _t, payload, _f: self.cx_pool.add_batch(payload),
             )
+        # Handel-style vote aggregation overlay (consensus.aggregation).
+        # "direct" (default) keeps today's exact point-to-point voting —
+        # bit-for-bit identical wire traffic; "handel" routes prepare/
+        # commit votes up the multi-level overlay and falls back to the
+        # direct vote whenever the overlay stalls.
+        self.aggregation_mode = str(registry.get("aggregation") or "direct")
+        self.aggregator = None
+        self._agg_subscribed: set = set()  # owned slot topics (no unsub)
+        self._agg_strikes: dict = {}       # frm -> forged-partial count
+        self._agg_hash: dict = {}          # phase -> seeded block hash
+        self._agg_trace_ctx: dict = {}     # phase -> traceparent bytes
+        self._agg_slot_of: dict = {}       # committee key -> slot index
+        self._agg_totals = {               # folded on round turnover
+            "inbound": 0, "merged": 0, "dup": 0, "stale": 0,
+            "forged": 0, "emissions": 0, "fallbacks": 0,
+        }
         self._new_round()
         # restart fast-forward, applied ONCE: rejoin the round at the
         # highest view this node's keys voted OR view-changed at
@@ -350,6 +372,7 @@ class Node:
         # second valid-looking announce (equivocating leader or forged
         # sender) is ignored, closing the two-block commit-quorum fork
         self._announce_voted: tuple | None = None
+        self._setup_aggregation(committee)
 
     # -- gossip ingress -----------------------------------------------------
 
@@ -408,6 +431,237 @@ class Node:
         else:
             self.sender.send_without_retry(env)
         return env
+
+    # -- vote aggregation overlay (consensus.aggregation) -------------------
+
+    def _setup_aggregation(self, committee: list):
+        """Per-round overlay construction (from ``_new_round``): fold
+        the finished round's counters into the node totals, then — in
+        handel mode, when this node holds committee slots — build the
+        round's :class:`Aggregator` and subscribe its owned slot
+        topics."""
+        agg = self.aggregator
+        if agg is not None:
+            t = self._agg_totals
+            t["inbound"] += agg.inbound
+            t["merged"] += agg.merged
+            t["dup"] += agg.dup_dropped
+            t["stale"] += agg.stale_dropped
+            t["forged"] += agg.forged
+            t["emissions"] += agg.emissions
+            t["fallbacks"] += agg.fallbacks
+        self.aggregator = None
+        self._agg_hash = {}
+        self._agg_trace_ctx = {}
+        if self.aggregation_mode != "handel" or not self._round_keys:
+            return
+        own = {k.pub.bytes for k in self._round_keys}
+        home_slots = [i for i, pk in enumerate(committee) if pk in own]
+        if not home_slots:
+            return
+        try:
+            leader_slot = committee.index(self._round_leader_key)
+        except ValueError:
+            return
+        self._agg_slot_of = {pk: i for i, pk in enumerate(committee)}
+        for s in home_slots:
+            topic = aggregation_topic(self.network, self.chain.shard_id, s)
+            if topic not in self._agg_subscribed:
+                self._agg_subscribed.add(topic)
+                self.host.add_validator(topic, self._agg_validator)
+                self.host.subscribe(topic, self._on_gossip_agg)
+        # the ladder must resolve well inside the phase timeout: levels
+        # escalate every ~1/20th of it, contributions re-emit twice per
+        # level, and the direct-vote fallback fires at half the timeout
+        # so a stalled overlay still leaves a full half for direct
+        # quorum assembly
+        level_t = max(0.05, min(1.0, self.phase_timeout / 20.0))
+        self.aggregator = AGG.Aggregator(
+            committee, home_slots,
+            self.leader.decider.is_quorum_achieved_by_mask,
+            self._emit_contribution,
+            leader_slot=leader_slot,
+            is_leader=self.is_leader,
+            committee_points=self.validator.committee_points,
+            level_timeout_s=level_t,
+            reemit_s=level_t / 2,
+            stall_timeout_s=max(1.0, self.phase_timeout * 0.5),
+        )
+
+    def _agg_validator(self, payload: bytes, frm: str) -> int:
+        """Bounded structural gate on aggregation-topic gossip: junk
+        frames and known forgers REJECT into the host peer-score
+        ladder; the pairing work runs on the pump's scored budget."""
+        if self._agg_strikes.get(frm, 0) >= 3:
+            return REJECT  # repeat forger: its traffic is punishable
+        try:
+            category, msg_type, body = parse_envelope(payload)
+            if category != MessageCategory.NODE or (
+                msg_type != NODE_MSG_AGG
+            ):
+                return REJECT
+            decode_aggregation(body)
+        except (ValueError, IndexError):
+            return REJECT
+        return ACCEPT
+
+    def _on_gossip_agg(self, topic: str, payload: bytes, frm: str):
+        # unlike _on_gossip, the sender identity rides along: a forged
+        # partial needs someone to charge the strike to
+        self._queue.put((payload, frm))
+
+    def _emit_contribution(self, target_slot: int, phase: int,
+                           level: int, bitmap: bytes, sig_bytes: bytes):
+        """Aggregator transport hook: publish one partial aggregate to
+        the target slot's directed topic."""
+        agg = self.aggregator
+        if agg is None:
+            return
+        body = encode_aggregation(AggContribution(
+            phase=phase,
+            view_id=self.view_id,
+            block_num=self.block_num,
+            block_hash=self._agg_hash.get(phase, bytes(32)),
+            level=level,
+            bitmap=bitmap,
+            sig=sig_bytes,
+            sender_slot=agg.home,
+        ))
+        self.host.publish(
+            aggregation_topic(self.network, self.chain.shard_id,
+                              target_slot),
+            pack_envelope(MessageCategory.NODE, NODE_MSG_AGG, body),
+        )
+
+    def _agg_seed(self, phase: int, payload: bytes, block_hash: bytes,
+                  sig_bytes: bytes, fallback=None):
+        """Activate a phase with this node's own locally-aggregated
+        vote; the direct vote message (when given) is stashed for the
+        stall fallback instead of broadcast."""
+        from .. import bls as B
+
+        agg = self.aggregator
+        bits = 0
+        for s in agg.home_slots:
+            bits |= 1 << s
+        self._agg_hash[phase] = block_hash
+        self._agg_trace_ctx[phase] = trace.traceparent()
+        agg.seed(phase, payload, bits, B.Signature.from_bytes(sig_bytes),
+                 fallback=fallback, now=time.monotonic())
+        self._aggregation_tick(time.monotonic())
+
+    def _agg_merge_ballot(self, phase: int, msg: FBFTMessage):
+        """Fold a direct fallback ballot the leader already
+        pairing-verified (fbft._on_vote) into the overlay's aggregate —
+        no second verify."""
+        from .. import bls as B
+
+        agg = self.aggregator
+        if agg is None:
+            return
+        bits = 0
+        for pk in msg.sender_pubkeys:
+            slot = self._agg_slot_of.get(pk)
+            if slot is None:
+                return
+            bits |= 1 << slot
+        try:
+            sig = B.Signature.from_bytes(msg.payload)
+        except ValueError:
+            return
+        agg.merge_verified(phase, bits, sig)
+
+    def _on_aggregation(self, body: bytes, frm: str = ""):
+        """Pump handler for one inbound partial aggregate."""
+        agg = self.aggregator
+        if agg is None:
+            return
+        try:
+            c = decode_aggregation(body)
+        except (ValueError, IndexError):
+            return
+        if (
+            c.block_num != self.block_num
+            or c.view_id != self.view_id
+            or len(c.bitmap) != agg.mask_len
+        ):
+            return  # another round's traffic: stale or early, not junk
+        want = self._agg_hash.get(c.phase)
+        if want is not None and c.block_hash != want:
+            return  # wrong block: would only fail the pairing check
+        agg.on_contribution(
+            c.phase, c.level, bytes(c.bitmap), bytes(c.sig), frm=frm
+        )
+        self._aggregation_tick(time.monotonic())
+
+    def _agg_quorum(self, phase: int) -> bool:
+        return self.aggregator is not None and self.aggregator.quorum(phase)
+
+    def aggregation_stats(self) -> dict:
+        """Cumulative overlay counters: node totals plus the live
+        round's aggregator (chaos invariants read this mid-run)."""
+        out = dict(self._agg_totals)
+        agg = self.aggregator
+        if agg is not None:
+            out["inbound"] += agg.inbound
+            out["merged"] += agg.merged
+            out["dup"] += agg.dup_dropped
+            out["stale"] += agg.stale_dropped
+            out["forged"] += agg.forged
+            out["emissions"] += agg.emissions
+            out["fallbacks"] += agg.fallbacks
+        return out
+
+    def _aggregation_tick(self, now: float):
+        """Drive the overlay: verify/merge the scored pending queue,
+        escalate levels, re-emit — each active phase's work lands in a
+        ``consensus.aggregation`` span (level attr) under the round's
+        trace, so forensics can attribute quorum_assembly time to the
+        ladder.  Stalled phases broadcast their stashed direct vote."""
+        agg = self.aggregator
+        if agg is None:
+            return
+        advanced = False
+        for phase in agg.active_phases():
+            st = agg.phases[phase]
+            due = st.pending or not st.last_emit or (
+                now - st.last_emit >= agg.reemit_s
+            )
+            if not due:
+                continue
+            with trace.resume(
+                self._agg_trace_ctx.get(phase, b""),
+                "consensus.aggregation", component="consensus",
+                phase=AGG.PHASE_NAMES.get(phase, str(phase)),
+                block=self.block_num,
+            ):
+                work = agg.tick(phase, now)
+                if work is None:
+                    continue
+                trace.annotate(
+                    level=work["level"], verified=work["verified"],
+                    merged=work["merged"], emitted=work["emitted"],
+                )
+                if work["merged"]:
+                    advanced = True
+                for frm in work["forged_from"]:
+                    if len(self._agg_strikes) < 256 or (
+                        frm in self._agg_strikes
+                    ):
+                        self._agg_strikes[frm] = (
+                            self._agg_strikes.get(frm, 0) + 1
+                        )
+        for phase in agg.stalled(now):
+            vote = agg.take_fallback(phase)
+            if vote is not None:
+                self.log.warn(
+                    "aggregation stalled: direct vote fallback",
+                    phase=AGG.PHASE_NAMES.get(phase, str(phase)),
+                    block=self.block_num,
+                )
+                self._broadcast(vote)
+        if advanced and self.is_leader:
+            self._leader_advance()
 
     # -- the pump -----------------------------------------------------------
 
@@ -500,6 +754,17 @@ class Node:
             "consensus.phase.prepare_quorum", component="consensus",
             parent=self._round_span, block=block.block_num,
         )
+        if self.aggregator is not None:
+            # the leader's own prepare aggregate (cast into the decider
+            # at announce) also seeds its overlay end — inbound partial
+            # aggregates merge against it
+            own = tuple(k.pub.bytes for k in self._round_keys)
+            sig = self.leader.prepare_sigs.get(own)
+            if sig is not None:
+                self._agg_seed(
+                    AGG.PHASE_PREPARE, prepare_payload(block.hash()),
+                    block.hash(), sig.bytes,
+                )
         # a leader whose own keys already meet quorum (single-operator
         # committee) must advance without waiting for external votes
         self._leader_advance()
@@ -571,21 +836,29 @@ class Node:
         with trace.node_scope(self._node_tag):
             while not self._stop.is_set():
                 try:
-                    payload = self._queue.get_nowait()
+                    item = self._queue.get_nowait()
                 except queue.Empty:
                     break
-                self._handle(payload)
+                # aggregation-topic deliveries carry the sender along
+                # (_on_gossip_agg) — everything else is bare payload
+                if isinstance(item, tuple):
+                    payload, frm = item
+                else:
+                    payload, frm = item, ""
+                self._handle(payload, frm)
                 n += 1
                 if max_msgs and n >= max_msgs:
                     break
         return n
 
-    def _handle(self, payload: bytes):
+    def _handle(self, payload: bytes, frm: str = ""):
         try:
             category, msg_type, body = parse_envelope(payload)
             if category == MessageCategory.NODE:
                 if msg_type == NODE_MSG_SLASH:
                     self._on_slash_record(body)
+                elif msg_type == NODE_MSG_AGG:
+                    self._on_aggregation(body, frm)
                 return
             if category != MessageCategory.CONSENSUS:
                 return
@@ -801,7 +1074,15 @@ class Node:
             )
             return
         vote = self.validator.on_announce(msg)
-        self._broadcast(vote)
+        if self.aggregator is not None:
+            # handel: the prepare vote enters the overlay instead of
+            # the wire — stashed whole for the stall fallback
+            self._agg_seed(
+                AGG.PHASE_PREPARE, prepare_payload(msg.block_hash),
+                msg.block_hash, vote.payload, fallback=vote,
+            )
+        else:
+            self._broadcast(vote)
         self.log.info(
             "prepare vote sent", block=msg.block_num, view=self.view_id,
         )
@@ -814,6 +1095,12 @@ class Node:
             return
         if not self._sent_prepared:
             prepared = self.leader.try_prepared(block_hash)
+            if prepared is None and self._agg_quorum(AGG.PHASE_PREPARE):
+                # overlay quorum before ballot-store quorum: PREPARED
+                # carries the ladder-assembled proof directly
+                prepared = self.leader.prepared_from_proof(
+                    block_hash, self.aggregator.proof(AGG.PHASE_PREPARE)
+                )
             if prepared is not None:
                 self._sent_prepared = True
                 self.log.info(
@@ -842,8 +1129,18 @@ class Node:
                     PHASE_COMMIT, block_hash,
                 ):
                     self.leader.on_commit(commit_vote)
+                    if self.aggregator is not None:
+                        self._agg_seed(
+                            AGG.PHASE_COMMIT,
+                            self.validator._commit_payload(block_hash),
+                            block_hash, commit_vote.payload,
+                        )
         if self._sent_prepared and not self._sent_committed:
             committed = self.leader.try_committed(block_hash)
+            if committed is None and self._agg_quorum(AGG.PHASE_COMMIT):
+                committed = self.leader.committed_from_proof(
+                    block_hash, self.aggregator.proof(AGG.PHASE_COMMIT)
+                )
             if committed is not None:
                 self._sent_committed = True
                 trace.finish(self._phase_span)
@@ -1117,13 +1414,15 @@ class Node:
         if not self.is_leader:
             return
         if self.leader.on_prepare(msg):
+            if self.aggregator is not None:
+                # direct fallback ballot under handel: fold it into the
+                # overlay so proof assembly sees every verified vote
+                self._agg_merge_ballot(AGG.PHASE_PREPARE, msg)
             self.log.info(
                 "prepare vote counted", block=self.block_num,
                 view=self.view_id, keys=len(self.leader.prepare_sigs),
             )
         else:
-            from ..consensus.signature import prepare_payload
-
             self._check_double_sign(
                 msg, self.leader.prepare_sigs, prepare_payload
             )
@@ -1158,12 +1457,22 @@ class Node:
                 self._prepared_block_bytes = rawdb.encode_block(
                     self._pending_block, self.chain.config.chain_id
                 )
-            self._broadcast(vote)
+            if self.aggregator is not None:
+                self._agg_seed(
+                    AGG.PHASE_COMMIT,
+                    self.validator._commit_payload(msg.block_hash),
+                    msg.block_hash, vote.payload, fallback=vote,
+                )
+            else:
+                self._broadcast(vote)
 
     def _on_commit(self, msg: FBFTMessage):
         if not self.is_leader:
             return
-        if not self.leader.on_commit(msg):
+        if self.leader.on_commit(msg):
+            if self.aggregator is not None:
+                self._agg_merge_ballot(AGG.PHASE_COMMIT, msg)
+        else:
             self._check_double_sign(
                 msg, self.leader.commit_sigs,
                 self.leader._commit_payload, phase="commit",
@@ -1561,6 +1870,7 @@ class Node:
                             # reference's consensus-timeout sync,
                             # consensus/downloader.go + view change spin)
                             self._spin_up_sync()
+                    self._aggregation_tick(now)
                     busy = self.process_pending()
                 except Exception as e:  # noqa: BLE001 — the pump is the
                     # node's heartbeat: one failed proposal or handler

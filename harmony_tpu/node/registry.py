@@ -16,7 +16,7 @@ class Registry:
         "blockchain", "beaconchain", "txpool", "engine", "worker",
         "host", "sync_client_factory", "webhooks", "metrics",
         "downloader", "discovery", "explorer", "rosetta",
-        "shard_count",
+        "shard_count", "aggregation",
     )
 
     def __init__(self, **initial):

@@ -86,6 +86,9 @@ class RoundTimeline:
     t0: float
     wall_s: float
     phases: dict = field(default_factory=dict)
+    # aggregation-overlay activity inside quorum_assembly, per ladder
+    # level ("L1", "L2", ..., summed consensus.aggregation span time)
+    levels: dict = field(default_factory=dict)
     partial: bool = False
     committed: bool = True
     nodes: tuple = ()
@@ -108,6 +111,7 @@ class RoundTimeline:
             "leader": self.leader,
             "wall_s": round(self.wall_s, 6),
             "phases": {p: round(s, 6) for p, s in self.phases.items()},
+            "levels": {lv: round(s, 6) for lv, s in self.levels.items()},
             "attributed_fraction": round(self.attributed_fraction(), 4),
             "dominant_phase": self.dominant_phase(),
             "partial": self.partial,
@@ -371,6 +375,22 @@ def _build_one(rnd: dict, group: list, all_spans: list) -> RoundTimeline:
     for q in (prep_q, commit_q):
         if q is not None:
             add("quorum_assembly", q["ts"], _end(q))
+
+    # aggregation overlay activity (ISSUE 20): consensus.aggregation
+    # spans — verify/merge/emit ticks of the Handel ladder — belong to
+    # quorum_assembly by definition, and their ``level`` attr breaks
+    # that phase down per ladder rung (round_forensics' per-level rows)
+    for s in children:
+        if s["name"] != "consensus.aggregation" \
+                or s.get("dur_s") is None:
+            continue
+        c = _clip(s["ts"], _end(s), t0, t1)
+        if c is None:
+            continue
+        add("quorum_assembly", c[0], c[1])
+        lvl = s.get("attrs", {}).get("level")
+        key = f"L{lvl}" if lvl is not None else "L?"
+        tl.levels[key] = tl.levels.get(key, 0.0) + (c[1] - c[0])
 
     # 0 positional base: makes the partition total on complete traces
     complete = (ann is not None and prep_q is not None

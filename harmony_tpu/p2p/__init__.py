@@ -15,7 +15,7 @@ crypto batch, not the socket.
 """
 
 from .gating import Gater
-from .groups import GroupID, consensus_topic, node_topic, slash_topic
+from .groups import GroupID, aggregation_topic, consensus_topic, node_topic, slash_topic
 from .host import Host, InProcessNetwork, TCPHost
 
 __all__ = [
@@ -24,6 +24,7 @@ __all__ = [
     "Host",
     "InProcessNetwork",
     "TCPHost",
+    "aggregation_topic",
     "consensus_topic",
     "node_topic",
     "slash_topic",

@@ -39,6 +39,15 @@ def crosslink_topic(network: str) -> str:
     return GroupID(network, 0, "crosslink").topic()
 
 
+def aggregation_topic(network: str, shard_id: int, slot: int) -> str:
+    """Per-SLOT directed topic for the Handel vote-aggregation overlay
+    (consensus.aggregation): a node subscribes only to the topics of
+    slots it holds keys for, so publishing a partial aggregate to a
+    slot's topic reaches exactly that slot's owner on both transports
+    — the overlay's point-to-point edges over gossip plumbing."""
+    return GroupID(network, shard_id, f"aggregation/{slot}").topic()
+
+
 def slash_topic(network: str, shard_id: int) -> str:
     """Double-sign evidence gossip (the reference publishes slashing
     candidates so non-leader observers aren't silenced; records dedup

@@ -28,7 +28,7 @@ import time
 from collections import OrderedDict
 
 from ..log import get_logger
-from ..metrics import LockedCounters
+from ..metrics import Counter, LockedCounters
 from ..ref.keccak import keccak256
 from .gating import Gater
 
@@ -47,6 +47,47 @@ P2P_COUNTERS = LockedCounters(
 )
 _WORST_LOCK = threading.Lock()
 _WORST_SCORE: dict[str, float] = {}  # host name -> worst live peer score
+
+# consensus-bearing inbound accounting (both transports route every
+# subscribed delivery through Host._deliver): how many vote-shaped
+# messages each node ingests per phase — THE quantity the Handel
+# aggregation overlay exists to shrink at the leader (O(log N)
+# aggregates vs N ballots).  Labelled family for /metrics; the
+# per-host dict feeds the chaos runner's leader_inbound_msgs_per_round
+INBOUND_VOTES = Counter(
+    "harmony_consensus_inbound_votes_total",
+    "consensus vote-bearing messages delivered, by phase and kind",
+)
+
+# CONSENSUS-category envelope types (node.ingress MsgType values; the
+# envelope layout [category u8][type u8][payload] is peeked here —
+# importing node.ingress would cycle, p2p must stay below node)
+_CONSENSUS_KINDS = {
+    0: ("prepare", "proposal"),   # ANNOUNCE
+    1: ("prepare", "ballot"),     # PREPARE
+    2: ("prepare", "proof"),      # PREPARED
+    3: ("commit", "ballot"),      # COMMIT
+    4: ("commit", "proof"),       # COMMITTED
+    5: ("viewchange", "vote"),    # VIEWCHANGE
+    6: ("viewchange", "proof"),   # NEWVIEW
+}
+_AGG_PHASES = {1: "prepare", 2: "commit"}
+
+
+def _classify_inbound(topic: str, payload: bytes):
+    """(phase, kind) of a consensus-bearing delivery, else None."""
+    if len(payload) < 3:
+        return None
+    if topic.endswith("/consensus"):
+        if payload[0] != 0x00:  # MessageCategory.CONSENSUS
+            return None
+        return _CONSENSUS_KINDS.get(payload[1])
+    if "/aggregation/" in topic:
+        if payload[0] != 0x01 or payload[1] != 0x11:  # NODE / AGG
+            return None
+        # aggregation body leads with its phase discriminant
+        return _AGG_PHASES.get(payload[2], "unknown"), "aggregate"
+    return None
 
 
 def _note_score(host_name: str, score: float):
@@ -175,6 +216,16 @@ class Host:
         # exactly-once per publish and re-publishes are deliberately
         # fresh messages (the consensus sender's retry semantics)
         self._lock = threading.Lock()
+        # (phase, kind) -> count of consensus-bearing deliveries THIS
+        # host actually handled (see _classify_inbound)
+        self.inbound_votes: dict[tuple, int] = {}
+        # target slot -> count of aggregation contributions delivered
+        # to that slot's directed topic: a localnet host multiplexes
+        # many committee slots, so per-HOST totals bundle rung traffic
+        # a real deployment spreads over one machine per slot — the
+        # per-slot split is what lets the chaos runner read off the
+        # leader slot's (the ladder's hottest target) actual ingest
+        self.inbound_agg_slots: dict[int, int] = {}
 
     # -- subscription API (reference: host.go:66-71) ------------------------
 
@@ -204,6 +255,19 @@ class Host:
     def _deliver(self, topic: str, payload: bytes, frm: str):
         with self._lock:
             handlers = list(self._handlers.get(topic, ()))
+        if not handlers:
+            return  # the in-process hub delivers to every host; only
+            #         a SUBSCRIBED host's ingest counts as inbound
+        cls = _classify_inbound(topic, payload)
+        if cls is not None:
+            with self._lock:
+                self.inbound_votes[cls] = self.inbound_votes.get(cls, 0) + 1
+                if cls[1] == "aggregate":
+                    slot = int(topic.rsplit("/", 1)[1])
+                    self.inbound_agg_slots[slot] = (
+                        self.inbound_agg_slots.get(slot, 0) + 1
+                    )
+            INBOUND_VOTES.inc(phase=cls[0], kind=cls[1])
         for h in handlers:
             h(topic, payload, frm)
 

@@ -1,0 +1,344 @@
+"""Handel-style vote aggregation (ISSUE 20): the level-ladder unit
+tier (topology, merge rules, forged-partial rejection, timeout
+escalation) plus deterministic localnet arcs — a 64-slot committee
+assembling quorum through the overlay with bounded leader inbound,
+and the direct-mode bit-parity guarantee (aggregation off produces
+byte-identical wire traffic)."""
+
+import time
+
+from harmony_tpu import bls as B
+from harmony_tpu.consensus import aggregation as AGG
+from harmony_tpu.consensus.mask import Mask
+from harmony_tpu.core.blockchain import Blockchain
+from harmony_tpu.core.genesis import dev_genesis
+from harmony_tpu.core.kv import MemKV
+from harmony_tpu.core.tx_pool import TxPool
+from harmony_tpu.multibls import PrivateKeys
+from harmony_tpu.node.node import Node
+from harmony_tpu.node.registry import Registry
+from harmony_tpu.p2p import InProcessNetwork
+from harmony_tpu.ref import bls as RB
+
+CHAIN_ID = 2
+
+
+# -- level topology ----------------------------------------------------------
+
+def test_num_levels():
+    assert AGG.num_levels(1) == 1
+    assert AGG.num_levels(2) == 1
+    assert AGG.num_levels(3) == 2
+    assert AGG.num_levels(4) == 2
+    assert AGG.num_levels(64) == 6
+    assert AGG.num_levels(200) == 8
+
+
+def test_level_peers_partition_power_of_two():
+    """For every slot the union of peers over all levels is exactly
+    the rest of the committee, each level's peers live in the OTHER
+    half of the slot's 2**level block, and no level self-includes."""
+    n = 16
+    for slot in range(n):
+        seen: set = set()
+        for level in range(1, AGG.num_levels(n) + 1):
+            peers = AGG.level_peers(slot, level, n)
+            assert slot not in peers
+            half = 1 << (level - 1)
+            base = (slot >> level) << level
+            own_half = range(base, base + half) if not (slot & half) \
+                else range(base + half, base + 2 * half)
+            assert not set(peers) & set(own_half)
+            assert not set(peers) & seen  # levels are disjoint
+            seen |= set(peers)
+        assert seen == set(range(n)) - {slot}
+
+
+def test_level_peers_clipped_committee():
+    """A non-power-of-two committee clips the top block: the union
+    still covers every other live slot, never a phantom one."""
+    n = 13
+    for slot in range(n):
+        seen: set = set()
+        for level in range(1, AGG.num_levels(n) + 1):
+            peers = AGG.level_peers(slot, level, n)
+            assert all(0 <= p < n for p in peers)
+            seen |= set(peers)
+        assert seen == set(range(n)) - {slot}
+
+
+def test_level_span_doubles_and_clips():
+    assert AGG.level_span(0, 1, 64) == (0, 2)
+    assert AGG.level_span(0, 6, 64) == (0, 64)
+    assert AGG.level_span(5, 2, 64) == (4, 8)
+    # clipped committee: the top block's span never exceeds n
+    assert AGG.level_span(12, 3, 13) == (8, 13)
+
+
+# -- the aggregator: merge rules, forgery, ladder ----------------------------
+
+def _mk_agg(n=8, home=0, leader_slot=0, **kw):
+    keys = [B.PrivateKey.generate(b"agg-unit-%d" % i) for i in range(n)]
+    committee = [k.pub.bytes for k in keys]
+    bar = (2 * n) // 3 + 1
+    emitted = []
+    agg = AGG.Aggregator(
+        committee, [home],
+        quorum_check=lambda bv: int(bv.sum()) >= bar,
+        emit=lambda t, ph, lv, bm, sg: emitted.append((t, ph, lv)),
+        leader_slot=leader_slot,
+        **kw,
+    )
+    return agg, keys, emitted
+
+
+def _contrib(keys, payload, slots):
+    """A genuine partial: aggregate sig + bitmap over ``slots``."""
+    sigs = [keys[s].sign_hash(payload) for s in slots]
+    bits = 0
+    for s in slots:
+        bits |= 1 << s
+    return bits, B.aggregate_sigs(sigs).bytes
+
+
+def test_merge_disjoint_adds_and_dedups():
+    agg, keys, _ = _mk_agg()
+    payload = b"\x11" * 32
+    agg.seed(AGG.PHASE_PREPARE, payload, 1, keys[0].sign_hash(payload))
+    bits, sig_b = _contrib(keys, payload, [1, 2])
+    bm = bits.to_bytes(agg.mask_len, "little")
+    assert agg.on_contribution(AGG.PHASE_PREPARE, 1, bm, sig_b) == "queued"
+    # byte-identical replay dedups for free, before any pairing work
+    assert agg.on_contribution(AGG.PHASE_PREPARE, 1, bm, sig_b) == "dup"
+    work = agg.tick(AGG.PHASE_PREPARE, now=0.0)
+    assert work["merged"] == 1 and work["forged"] == 0
+    assert agg.signed_count(AGG.PHASE_PREPARE) == 3
+    # the merged aggregate genuinely verifies against the mask
+    mask = Mask(agg.committee_points)
+    mask.set_mask((0b111).to_bytes(agg.mask_len, "little"))
+    st = agg.phases[AGG.PHASE_PREPARE]
+    assert RB.verify(mask.aggregate_public(device=False), payload,
+                     st.sig.point)
+    # a subset contribution carries zero new weight: dropped pre-verify
+    sub_bits, sub_sig = _contrib(keys, payload, [2])
+    assert agg.on_contribution(
+        AGG.PHASE_PREPARE, 1,
+        sub_bits.to_bytes(agg.mask_len, "little"), sub_sig,
+    ) == "stale"
+    assert agg.merged == 1 and agg.dup_dropped == 1
+
+
+def test_merge_overlapping_keeps_heavier():
+    """Overlapping verified aggregates cannot add (the overlap would
+    double-count); the heavier one wins wholesale."""
+    agg, keys, _ = _mk_agg()
+    payload = b"\x22" * 32
+    agg.seed(AGG.PHASE_PREPARE, payload, 0b11,
+             B.aggregate_sigs([keys[0].sign_hash(payload),
+                               keys[1].sign_hash(payload)]))
+    bits, sig_b = _contrib(keys, payload, [1, 2, 3])
+    agg.on_contribution(AGG.PHASE_PREPARE, 1,
+                        bits.to_bytes(agg.mask_len, "little"), sig_b)
+    work = agg.tick(AGG.PHASE_PREPARE, now=0.0)
+    assert work["merged"] == 1
+    st = agg.phases[AGG.PHASE_PREPARE]
+    assert st.bits == 0b1110  # replaced, not OR-ed
+    mask = Mask(agg.committee_points)
+    mask.set_mask(st.bits.to_bytes(agg.mask_len, "little"))
+    assert RB.verify(mask.aggregate_public(device=False), payload,
+                     st.sig.point)
+
+
+def test_forged_partial_rejected_never_merged():
+    agg, keys, _ = _mk_agg()
+    payload = b"\x33" * 32
+    agg.seed(AGG.PHASE_PREPARE, payload, 1, keys[0].sign_hash(payload))
+    # a REAL signature over a different payload: parses fine, fails
+    # the aggregate pairing check — the Byzantine forgery shape
+    bits, sig_b = _contrib(keys, b"\x44" * 32, [1, 2])
+    agg.on_contribution(AGG.PHASE_PREPARE, 1,
+                        bits.to_bytes(agg.mask_len, "little"), sig_b,
+                        frm="evil")
+    work = agg.tick(AGG.PHASE_PREPARE, now=0.0)
+    assert work["forged"] == 1 and work["merged"] == 0
+    assert work["forged_from"] == ["evil"]
+    assert agg.signed_count(AGG.PHASE_PREPARE) == 1  # untouched
+    # malformed shapes are verdicts, not exceptions
+    assert agg.on_contribution(
+        AGG.PHASE_PREPARE, 1, bytes(agg.mask_len + 1), sig_b,
+    ) == "malformed"
+    assert agg.on_contribution(
+        AGG.PHASE_PREPARE, 1, bytes(agg.mask_len), sig_b) == "malformed"
+
+
+def test_timeout_escalation_reaches_leader():
+    """With no inbound help, per-level timeouts walk the ladder to the
+    final rung and the best (lone) contribution ships direct to the
+    leader slot — Handel's loss tolerance."""
+    agg, keys, emitted = _mk_agg(
+        home=3, leader_slot=5,
+        level_timeout_s=0.1, reemit_s=0.05,
+    )
+    payload = b"\x55" * 32
+    agg.seed(AGG.PHASE_PREPARE, payload, 1 << 3,
+             keys[3].sign_hash(payload), now=0.0)
+    agg.tick(AGG.PHASE_PREPARE, now=0.0)
+    assert emitted, "first tick must emit to level-1 peers"
+    assert all(t != 5 for t, _, _ in emitted)  # not the leader yet
+    # stride past every level timeout (respecting the reemit cadence)
+    now = 0.0
+    for _ in range(agg.n_levels + 2):
+        now += 0.15
+        agg.tick(AGG.PHASE_PREPARE, now=now)
+    assert emitted[-1][0] == 5  # final rung: direct to the leader
+    assert agg.phases[AGG.PHASE_PREPARE].final_sent >= 1
+
+
+def test_quorum_and_proof_shape():
+    agg, keys, _ = _mk_agg()
+    payload = b"\x66" * 32
+    agg.seed(AGG.PHASE_COMMIT, payload, 1, keys[0].sign_hash(payload))
+    assert not agg.quorum(AGG.PHASE_COMMIT)
+    bits, sig_b = _contrib(keys, payload, [1, 2, 3, 4, 5, 6])
+    agg.on_contribution(AGG.PHASE_COMMIT, 2,
+                        bits.to_bytes(agg.mask_len, "little"), sig_b)
+    agg.tick(AGG.PHASE_COMMIT, now=0.0)
+    assert agg.quorum(AGG.PHASE_COMMIT)  # 7 of 8 >= 2n/3+1
+    proof = agg.proof(AGG.PHASE_COMMIT)
+    assert len(proof) == 96 + agg.mask_len
+    mask = Mask(agg.committee_points)
+    mask.set_mask(proof[96:])
+    assert RB.verify(mask.aggregate_public(device=False), payload,
+                     B.Signature.from_bytes(proof[:96]).point)
+
+
+def test_fallback_is_one_shot():
+    agg, keys, _ = _mk_agg(stall_timeout_s=0.2)
+    payload = b"\x77" * 32
+    agg.seed(AGG.PHASE_PREPARE, payload, 1, keys[0].sign_hash(payload),
+             fallback="direct-vote", now=0.0)
+    assert agg.stalled(0.1) == []
+    assert agg.stalled(0.5) == [AGG.PHASE_PREPARE]
+    assert agg.take_fallback(AGG.PHASE_PREPARE) == "direct-vote"
+    assert agg.take_fallback(AGG.PHASE_PREPARE) is None
+    assert agg.stalled(1.0) == []  # taken: never offered again
+    assert agg.fallbacks == 1
+
+
+# -- localnet arcs -----------------------------------------------------------
+
+def _make_localnet(n_nodes=4, keys_per_node=1, aggregation=None):
+    genesis, ecdsa_keys, bls_keys = dev_genesis(
+        n_keys=n_nodes * keys_per_node
+    )
+    net = InProcessNetwork()
+    nodes = []
+    for i in range(n_nodes):
+        chain = Blockchain(MemKV(), genesis, blocks_per_epoch=16)
+        pool = TxPool(CHAIN_ID, 0, chain.state)
+        reg = Registry(
+            blockchain=chain, txpool=pool, host=net.host(f"node{i}")
+        )
+        if aggregation is not None:
+            reg.set("aggregation", aggregation)
+        ks = bls_keys[i * keys_per_node:(i + 1) * keys_per_node]
+        nodes.append(Node(reg, PrivateKeys.from_keys(ks)))
+    return nodes, net
+
+
+def _pump_agg(nodes, done, budget_s=30.0):
+    """Drive pumps + overlay ticks until ``done()`` or the budget."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        busy = any([n.process_pending() for n in nodes])
+        now = time.monotonic()
+        for n in nodes:
+            n._aggregation_tick(now)
+        if done():
+            return True
+        if not busy:
+            time.sleep(0.005)
+    return done()
+
+
+def test_handel_localnet_commits():
+    """4 single-key nodes, overlay on: two rounds commit, quorum was
+    assembled from merged contributions, zero forged partials and zero
+    stall fallbacks on a clean network."""
+    nodes, net = _make_localnet(4, aggregation="handel")
+    assert all(n.aggregator is not None for n in nodes)
+    for target in (1, 2):
+        leader = next(n for n in nodes if n.is_leader)
+        leader.start_round_if_leader()
+        assert _pump_agg(
+            nodes,
+            lambda: all(n.chain.head_number == target for n in nodes),
+        ), f"round {target} never committed through the overlay"
+    stats = [n.aggregation_stats() for n in nodes]
+    assert sum(s["merged"] for s in stats) > 0
+    assert sum(s["forged"] for s in stats) == 0
+    assert sum(s["fallbacks"] for s in stats) == 0
+    assert all(n.chain.read_commit_sig(2) is not None for n in nodes)
+
+
+def test_handel_64_slot_assembly_bounded_inbound():
+    """The ISSUE 20 shape: a 64-slot committee (16-key operators, the
+    wan_committee topology) assembles prepare AND commit quorums
+    through the ladder; the leader ingests at most committee_size/4
+    vote-bearing messages for the round — O(log N) assembly, not N."""
+    nodes, net = _make_localnet(4, keys_per_node=16,
+                                aggregation="handel")
+    leader = next(n for n in nodes if n.is_leader)
+    assert all(len(n.aggregator.home_slots) == 16 for n in nodes)
+    assert nodes[0].aggregator.n == 64
+    leader.start_round_if_leader()
+    assert _pump_agg(
+        nodes,
+        lambda: all(n.chain.head_number == 1 for n in nodes),
+    ), "the 64-slot round never committed through the overlay"
+    inbound = sum(
+        v for (_ph, kind), v in leader.host.inbound_votes.items()
+        if kind in ("ballot", "aggregate")
+    )
+    assert inbound <= 64 // 4, (
+        f"leader ingested {inbound} vote msgs (> 16 = slots/4)"
+    )
+    stats = [n.aggregation_stats() for n in nodes]
+    assert sum(s["forged"] for s in stats) == 0
+
+
+def _record_wire(nodes):
+    rec = []
+    for n in nodes:
+        orig = n.host.publish
+
+        def pub(topic, payload, _orig=orig, _name=n.host.name):
+            rec.append((_name, topic, payload))
+            return _orig(topic, payload)
+
+        n.host.publish = pub
+    return rec
+
+
+def _one_recorded_round(aggregation):
+    nodes, net = _make_localnet(4, aggregation=aggregation)
+    rec = _record_wire(nodes)
+    leader = next(n for n in nodes if n.is_leader)
+    leader.start_round_if_leader()
+    assert _pump_agg(
+        nodes, lambda: all(n.chain.head_number == 1 for n in nodes)
+    )
+    return rec
+
+
+def test_direct_mode_bit_parity():
+    """aggregation = "direct" must restore the exact pre-overlay wire
+    behavior: byte-identical message sequences to an unconfigured
+    node, and not a single aggregation-topic publish."""
+    base = _one_recorded_round(aggregation=None)
+    direct = _one_recorded_round(aggregation="direct")
+    assert base == direct  # byte-for-byte, including ballot sigs
+    assert all("/aggregation/" not in topic for _, topic, _p in base)
+    # and the overlay mode really is what moves votes off the topic
+    handel = _one_recorded_round(aggregation="handel")
+    assert any("/aggregation/" in topic for _, topic, _p in handel)

@@ -148,6 +148,7 @@ def test_scenario_registry_names_and_shape():
         "gray_leader", "asymmetric_partition",
         "minority_partition_heal", "wan_committee",
         "mainnet_rehearsal",
+        "wan_committee_200", "gray_aggregator",
     }
     for name, builder in SCENARIOS.items():
         for quick in (False, True):

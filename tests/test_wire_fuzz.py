@@ -541,6 +541,70 @@ def test_span_sink_reader_oversize_line_budget(tmp_path):
     assert len(out) == 1 and out[0]["span_id"] == "ef" * 8
 
 
+# -- ISSUE 20: the aggregation-overlay wire message --------------------------
+#
+# AggContribution is NODE-category gossip a hostile peer fully
+# controls, decoded by every slot-topic owner before any pairing work:
+# the decoder must reject flips/truncations/bitmap-length inflation
+# with typed errors, never allocate against a forged length, and hold
+# the AGG_BITMAP_MAX budget (GL13 discipline).
+
+
+def _agg_contribution_base() -> bytes:
+    from harmony_tpu.consensus.messages import (
+        AggContribution, encode_aggregation,
+    )
+
+    return encode_aggregation(AggContribution(
+        phase=1, view_id=7, block_num=42, block_hash=bytes(range(32)),
+        level=3, bitmap=b"\x0f" * 25, sig=b"\x02" * 96, sender_slot=5,
+    ))
+
+
+def test_fuzz_aggregation_decoder():
+    from harmony_tpu.consensus.messages import decode_aggregation
+
+    _fuzz(decode_aggregation, _agg_contribution_base())
+
+
+def test_aggregation_bitmap_inflation_rejected_fast():
+    """A contribution claiming a 64 KiB bitmap (or one past
+    AGG_BITMAP_MAX) dies on the length check before the decoder sizes
+    anything against it."""
+    from harmony_tpu.consensus.messages import (
+        AGG_BITMAP_MAX, decode_aggregation,
+    )
+
+    base = bytearray(_agg_contribution_base())
+    # bitmap_len u16 rides after [phase u8][view u64][block u64]
+    # [hash 32][level u8]
+    off = 1 + 8 + 8 + 32 + 1
+    for forged in (0xFFFF, AGG_BITMAP_MAX + 1):
+        buf = bytearray(base)
+        struct.pack_into("<H", buf, off, forged)
+        t0 = time.monotonic()
+        with pytest.raises(ValueError):
+            decode_aggregation(bytes(buf))
+        assert time.monotonic() - t0 < 0.1
+
+
+def test_aggregation_truncation_and_trailer_rejected():
+    """Every truncation of a valid frame — and any frame with trailing
+    bytes past the declared bitmap — is a typed rejection: the decoder
+    demands the exact length it computed."""
+    from harmony_tpu.consensus.messages import decode_aggregation
+
+    base = _agg_contribution_base()
+    for cut in range(len(base)):
+        with pytest.raises(TYPED):
+            decode_aggregation(base[:cut])
+    with pytest.raises(TYPED):
+        decode_aggregation(base + b"\x00")
+    for bad_phase in (0, 3, 255):
+        with pytest.raises(TYPED):
+            decode_aggregation(bytes([bad_phase]) + base[1:])
+
+
 def test_stored_batch_count_inflation_rejected_fast():
     """A corrupted (or crash-torn) store blob forging the leading
     batch count must raise, not spin garbage-object loops."""

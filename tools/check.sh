@@ -201,7 +201,8 @@ BYZ_ROUND="$(mktemp)"
 SOAK_ROUND="$(mktemp)"
 NETEM_ROUND="$(mktemp)"
 REHEARSAL_ROUND="$(mktemp)"
-trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND" "$NETEM_ROUND" "$REHEARSAL_ROUND"' EXIT
+AGG_ROUND="$(mktemp)"
+trap 'rm -f "$CHAOS_ROUND" "$CRASH_ROUND" "$BYZ_ROUND" "$SOAK_ROUND" "$NETEM_ROUND" "$REHEARSAL_ROUND" "$AGG_ROUND"' EXIT
 JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
   --scenario view_change_storm --scenario epoch_election_rotation \
   --scenario cross_shard_partition --scenario validator_churn \
@@ -284,5 +285,15 @@ JAX_PLATFORMS=cpu python tools/round_forensics.py \
   --scenario wan_committee --quick --check > /dev/null
 python tools/bench_ledger.py --check --threshold 0.8 \
   BENCH_r*.json > /dev/null
+
+echo "== vote aggregation: overlay unit tier + 200-slot WAN committee =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_aggregation.py
+JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick --check \
+  --scenario wan_committee_200 --scenario gray_aggregator \
+  --bench-out "$AGG_ROUND" --bench-round 993 > /dev/null
+python tools/bench_ledger.py --check --threshold 0.8 \
+  BENCH_r*.json "$AGG_ROUND" > /dev/null
 
 echo "check.sh: OK"

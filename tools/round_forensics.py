@@ -58,6 +58,10 @@ def _aggregate(timelines) -> dict:
         for p, s in t.phases.items():
             phase_s[p] += s
             per_phase[p].append(s)
+    level_s: dict = {}
+    for t in timelines:
+        for lv, s in getattr(t, "levels", {}).items():
+            level_s[lv] = level_s.get(lv, 0.0) + s
     attributed = sum(phase_s.values())
     frac = (attributed / total_wall) if total_wall > 0 else 0.0
     dominant = max(phase_s.items(), key=lambda kv: kv[1])[0] \
@@ -82,6 +86,11 @@ def _aggregate(timelines) -> dict:
         "phase_seconds": {p: round(s, 6) for p, s in phase_s.items()
                           if s > 0},
         "phases": quant,
+        # aggregation-overlay attribution INSIDE quorum_assembly: time
+        # spent merging/verifying contributions, keyed by Handel level
+        # ("L1", "L2", ...) — nonempty only when the overlay ran
+        "aggregation_levels": {lv: round(s, 6)
+                               for lv, s in sorted(level_s.items())},
     }
 
 
@@ -165,6 +174,11 @@ def main(argv=None) -> int:
               f"{agg['attributed_fraction'] * 100:.1f}% attributed, "
               f"dominant phase: {agg['dominant_phase']}",
               file=sys.stderr)
+        if agg["aggregation_levels"]:
+            lv = ", ".join(f"{k}={s:.3f}s"
+                           for k, s in agg["aggregation_levels"].items())
+            print(f"round_forensics: quorum_assembly overlay levels: {lv}",
+                  file=sys.stderr)
 
     if args.check:
         if not committed:
